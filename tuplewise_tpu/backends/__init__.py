@@ -1,0 +1,3 @@
+from tuplewise_tpu.backends.base import get_backend, register_backend
+
+__all__ = ["get_backend", "register_backend"]

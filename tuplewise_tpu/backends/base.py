@@ -1,0 +1,59 @@
+"""The Estimator(backend=...) boundary [SURVEY §7 step 3, BASELINE.json:5].
+
+A backend owns *execution*: how pair/triplet sums are tiled, where
+randomness comes from, and how per-worker results are aggregated. The
+estimator semantics (complete / local-average / repartitioned /
+incomplete, SURVEY §1.2) live above this boundary and are identical
+across backends:
+
+* ``numpy`` — the serial reference oracle (frozen semantics).
+* ``jax``   — single-device XLA: tiled `lax` loops, `jax.random`.
+* ``mesh``  — multi-chip SPMD: `shard_map` over a 1-D mesh, `ppermute`
+  ring for cross-shard pairs, `psum` aggregation.
+
+Every backend implements the same four estimator entry points with the
+same statistical meaning, so oracle-parity tests are a for-loop over
+backends [SURVEY §5.1].
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_BACKENDS: Dict[str, Callable] = {}
+
+
+def register_backend(name: str):
+    def deco(cls):
+        _BACKENDS[name] = cls
+        return cls
+    return deco
+
+
+_LAZY = {
+    "numpy": "tuplewise_tpu.backends.numpy_backend",
+    "jax": "tuplewise_tpu.backends.jax_backend",
+    "mesh": "tuplewise_tpu.backends.mesh_backend",
+}
+
+
+def get_backend(name: str, kernel, **opts):
+    # Import lazily so `numpy`-only use never imports jax.
+    if name not in _BACKENDS and name in _LAZY:
+        import importlib
+
+        try:
+            importlib.import_module(_LAZY[name])
+        except ImportError as e:
+            raise RuntimeError(
+                f"backend {name!r} is registered but failed to import "
+                f"({_LAZY[name]}): {e}"
+            ) from e
+    try:
+        cls = _BACKENDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown backend {name!r}; available: "
+            f"{sorted(set(_BACKENDS) | set(_LAZY))}"
+        ) from None
+    return cls(kernel, **opts)

@@ -1,0 +1,192 @@
+"""The NumPy reference backend — the frozen oracle path.
+
+Serial, blockwise (never materializes the full pair grid), pure NumPy.
+Implements the four estimator schemes of [SURVEY §1.2] exactly as the
+call-stack traces in [SURVEY §4.1-4.3] describe. Every other backend is
+tested against this one [SURVEY §5.1 "Oracle parity"]; per the north star
+it stays untouched by TPU work (BASELINE.json:5).
+
+Identity discipline: one-sample U-statistics range over pairs of
+*distinct data points*. Under with-replacement ("swr") partitioning a
+worker block can hold the same original point twice, so exclusion is done
+on original indices (``ids``), not on block positions — positional-only
+exclusion would bias swr local averages by a (1 - 1/n) factor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tuplewise_tpu.backends.base import register_backend
+from tuplewise_tpu.ops.kernels import Kernel, get_kernel
+from tuplewise_tpu.parallel.partition import partition_indices, partition_two_sample
+
+_BLOCK = 4096
+
+
+@register_backend("numpy")
+class NumpyBackend:
+    """Serial oracle. All estimator methods return python floats."""
+
+    name = "numpy"
+
+    def __init__(self, kernel: Kernel, block_size: int = _BLOCK):
+        self.kernel = get_kernel(kernel)
+        self.block = int(block_size)
+
+    # ------------------------------------------------------------------ #
+    # primitives                                                          #
+    # ------------------------------------------------------------------ #
+    def _pair_stats(
+        self,
+        A: np.ndarray,
+        B: np.ndarray,
+        ids_a: Optional[np.ndarray] = None,
+        ids_b: Optional[np.ndarray] = None,
+    ) -> Tuple[float, int]:
+        """(sum, count) of h over the A x B grid, tiled [SURVEY §4.1],
+        skipping cells whose original indices coincide (if ids given)."""
+        k, blk = self.kernel, self.block
+        total, count = 0.0, 0
+        for i0 in range(0, len(A), blk):
+            a = A[i0 : i0 + blk]
+            ia = None if ids_a is None else ids_a[i0 : i0 + blk]
+            for j0 in range(0, len(B), blk):
+                m = np.asarray(k.pair_matrix(a, B[j0 : j0 + blk], np))
+                if ia is not None:
+                    jb = ids_b[j0 : j0 + m.shape[1]]
+                    valid = ia[:, None] != jb[None, :]
+                    total += float(np.sum(m * valid))
+                    count += int(np.sum(valid))
+                else:
+                    total += float(np.sum(m))
+                    count += m.size
+        return total, count
+
+    def _triplet_stats(
+        self,
+        X: np.ndarray,
+        Y: np.ndarray,
+        ids_x: Optional[np.ndarray] = None,
+    ) -> Tuple[float, int]:
+        """(sum, count) of h(x_i, x_j, y_k) over i != j (by original id),
+        all k [degree-(2,1), SURVEY §1.1]. O(n1^2 n2) — complete degree-3
+        only ever runs at small n; incomplete is the practical path
+        [SURVEY §7 step 7]."""
+        k = self.kernel
+        n1, n2 = len(X), len(Y)
+        if ids_x is None:
+            ids_x = np.arange(n1)
+        total, count = 0.0, 0
+        for i in range(n1):
+            a = X[i : i + 1]
+            vals = np.asarray(
+                k.triplet_values(a[:, None, :], X[:, None, :], Y[None, :, :], np)
+            )  # [n1, n2]
+            valid = ids_x != ids_x[i]  # excludes j == i and duplicate draws
+            total += float(np.sum(vals[valid]))
+            count += int(np.sum(valid)) * n2
+        return total, count
+
+    # ------------------------------------------------------------------ #
+    # estimator schemes                                                   #
+    # ------------------------------------------------------------------ #
+    def complete(self, A: np.ndarray, B: np.ndarray = None) -> float:
+        """Complete U-statistic U_n — all tuples [SURVEY §1.1, §4.1]."""
+        k = self.kernel
+        if k.kind == "triplet":
+            s, c = self._triplet_stats(A, B)
+            return s / c
+        if k.two_sample:
+            s, c = self._pair_stats(A, B)
+            return s / c
+        ids = np.arange(len(A))
+        s, c = self._pair_stats(A, A, ids, ids)  # excludes the diagonal
+        return s / c
+
+    def local_average(
+        self,
+        A: np.ndarray,
+        B: np.ndarray = None,
+        *,
+        n_workers: int,
+        seed: int = 0,
+        scheme: str = "swor",
+    ) -> float:
+        """U^loc_N: mean of per-worker complete U over a proportional
+        partition [SURVEY §1.2 item 2, §4.2 inner loop]."""
+        rng = np.random.default_rng(seed)
+        return self._local_average_once(A, B, n_workers, rng, scheme)
+
+    def _local_average_once(self, A, B, n_workers, rng, scheme) -> float:
+        k = self.kernel
+        vals = []
+        if k.kind == "triplet":
+            pi, ni = partition_two_sample(len(A), len(B), n_workers, rng, scheme)
+            for w in range(n_workers):
+                s, c = self._triplet_stats(A[pi[w]], B[ni[w]], ids_x=pi[w])
+                vals.append(s / c)
+        elif k.two_sample:
+            pi, ni = partition_two_sample(len(A), len(B), n_workers, rng, scheme)
+            for w in range(n_workers):
+                s, c = self._pair_stats(A[pi[w]], B[ni[w]])
+                vals.append(s / c)
+        else:
+            idx = partition_indices(len(A), n_workers, rng, scheme)
+            for w in range(n_workers):
+                s, c = self._pair_stats(A[idx[w]], A[idx[w]], idx[w], idx[w])
+                vals.append(s / c)
+        return float(np.mean(vals))
+
+    def repartitioned(
+        self,
+        A: np.ndarray,
+        B: np.ndarray = None,
+        *,
+        n_workers: int,
+        n_rounds: int,
+        seed: int = 0,
+        scheme: str = "swor",
+    ) -> float:
+        """U_{N,T}: average of T local-average rounds, one reshuffle per
+        round — repartitions buy variance [SURVEY §1.2 item 3, §4.2]."""
+        rng = np.random.default_rng(seed)
+        ests = [
+            self._local_average_once(A, B, n_workers, rng, scheme)
+            for _ in range(n_rounds)
+        ]
+        return float(np.mean(ests))
+
+    def incomplete(
+        self,
+        A: np.ndarray,
+        B: np.ndarray = None,
+        *,
+        n_pairs: int,
+        seed: int = 0,
+    ) -> float:
+        """Incomplete U-statistic: B tuples drawn uniformly with
+        replacement from the tuple grid [SURVEY §1.1, §4.3]."""
+        k = self.kernel
+        rng = np.random.default_rng(seed)
+        if k.kind == "triplet":
+            n1, n2 = len(A), len(B)
+            i = rng.integers(0, n1, size=n_pairs)
+            # j must differ from i: draw from n1-1 and shift past i.
+            j = rng.integers(0, n1 - 1, size=n_pairs)
+            j = np.where(j >= i, j + 1, j)
+            kk = rng.integers(0, n2, size=n_pairs)
+            vals = k.triplet_values(A[i], A[j], B[kk], np)
+            return float(np.mean(vals))
+        if k.two_sample:
+            i = rng.integers(0, len(A), size=n_pairs)
+            j = rng.integers(0, len(B), size=n_pairs)
+            return float(np.mean(k.pair_elementwise(A[i], B[j], np)))
+        # one-sample: draw i != j uniformly from the off-diagonal grid
+        n = len(A)
+        i = rng.integers(0, n, size=n_pairs)
+        j = rng.integers(0, n - 1, size=n_pairs)
+        j = np.where(j >= i, j + 1, j)
+        return float(np.mean(k.pair_elementwise(A[i], A[j], np)))

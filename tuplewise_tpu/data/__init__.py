@@ -1,0 +1,9 @@
+from tuplewise_tpu.data.synthetic import make_gaussians, true_gaussian_auc
+from tuplewise_tpu.data.loaders import load_adult, load_mnist_embeddings
+
+__all__ = [
+    "make_gaussians",
+    "true_gaussian_auc",
+    "load_adult",
+    "load_mnist_embeddings",
+]

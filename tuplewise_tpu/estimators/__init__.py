@@ -1,0 +1,21 @@
+from tuplewise_tpu.estimators.estimator import Estimator
+from tuplewise_tpu.estimators.variance import (
+    two_sample_zetas,
+    two_sample_variance,
+    one_sample_zetas,
+    one_sample_variance,
+    incomplete_variance,
+    local_average_variance,
+    repartitioned_variance,
+)
+
+__all__ = [
+    "Estimator",
+    "two_sample_zetas",
+    "two_sample_variance",
+    "one_sample_zetas",
+    "one_sample_variance",
+    "incomplete_variance",
+    "local_average_variance",
+    "repartitioned_variance",
+]

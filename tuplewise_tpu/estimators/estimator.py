@@ -1,0 +1,112 @@
+"""L3 — the user-facing Estimator with the backend plugin boundary.
+
+``Estimator(kernel=..., backend=...)`` [SURVEY §2 L3, §7 step 3;
+BASELINE.json:5]. Semantics (what is estimated) are fixed here; execution
+(how the tuple sums run: serial NumPy, tiled XLA, or SPMD over a TPU
+mesh) is the backend's job.
+
+Input convention:
+* score-difference kernels ("auc", "hinge", "logistic") take 1-D *score*
+  arrays — apply your scoring function first (see
+  tuplewise_tpu.models.scorers), mirroring the reference's separation of
+  scoring from kernel evaluation [SURVEY §1.1].
+* feature kernels ("scatter", triplet kernels) take [n, d] feature arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from tuplewise_tpu.backends.base import get_backend
+from tuplewise_tpu.ops.kernels import get_kernel
+
+
+class Estimator:
+    """Distributed tuplewise (U-statistic) estimator [SURVEY §1.2].
+
+    Args:
+      kernel: kernel name or Kernel instance (L1 plugin).
+      backend: "numpy" (serial oracle), "jax" (single-device XLA),
+        or "mesh" (SPMD over a device mesh).
+      n_workers: default number of (simulated or real) workers N.
+      **backend_opts: forwarded to the backend constructor
+        (e.g. block_size, mesh).
+    """
+
+    def __init__(self, kernel="auc", backend: str = "numpy",
+                 n_workers: int = 1, **backend_opts):
+        self.kernel = get_kernel(kernel)
+        self.n_workers = int(n_workers)
+        self.backend_name = backend
+        self.backend = get_backend(backend, self.kernel, **backend_opts)
+
+    # ------------------------------------------------------------------ #
+    def _resolve_workers(self, n_workers: Optional[int]) -> int:
+        n = self.n_workers if n_workers is None else n_workers
+        if n < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n}")
+        return n
+
+    def _prep(self, A, B):
+        k = self.kernel
+        A = np.asarray(A, dtype=np.float64)
+        B = None if B is None else np.asarray(B, dtype=np.float64)
+        if k.two_sample and B is None:
+            raise ValueError(f"kernel {k.name!r} is two-sample: pass (A, B)")
+        if not k.two_sample and B is not None:
+            raise ValueError(f"kernel {k.name!r} is one-sample: pass A only")
+        if k.kind == "diff":
+            if A.ndim == 2 and A.shape[1] == 1:
+                A = A[:, 0]
+            if B is not None and B.ndim == 2 and B.shape[1] == 1:
+                B = B[:, 0]
+            if A.ndim != 1 or (B is not None and B.ndim != 1):
+                raise ValueError(
+                    f"kernel {k.name!r} operates on scalar scores; got "
+                    f"shapes {A.shape}{'' if B is None else ', ' + str(B.shape)}. "
+                    "Apply a scorer (tuplewise_tpu.models.scorers) first."
+                )
+        elif A.ndim != 2 or (B is not None and B.ndim != 2):
+            raise ValueError(f"kernel {k.name!r} expects [n, d] features")
+        return A, B
+
+    # ------------------------------------------------------------------ #
+    # the four estimator schemes [SURVEY §1.2]                            #
+    # ------------------------------------------------------------------ #
+    def complete(self, A, B=None) -> float:
+        """Complete U_n — every tuple, the gold standard [SURVEY §1.2.1]."""
+        A, B = self._prep(A, B)
+        return float(self.backend.complete(A, B))
+
+    def local_average(self, A, B=None, *, seed: int = 0,
+                      scheme: str = "swor",
+                      n_workers: Optional[int] = None) -> float:
+        """U^loc_N — per-worker complete U, averaged; zero repartition
+        cost, extra variance from ignored cross-worker tuples
+        [SURVEY §1.2.2]."""
+        A, B = self._prep(A, B)
+        return float(self.backend.local_average(
+            A, B, n_workers=self._resolve_workers(n_workers),
+            seed=seed, scheme=scheme))
+
+    def repartitioned(self, A, B=None, *, n_rounds: int, seed: int = 0,
+                      scheme: str = "swor",
+                      n_workers: Optional[int] = None) -> float:
+        """U_{N,T} — T reshuffle rounds of local averaging; communication
+        buys variance [SURVEY §1.2.3]."""
+        if n_rounds < 1:
+            raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")
+        A, B = self._prep(A, B)
+        return float(self.backend.repartitioned(
+            A, B, n_workers=self._resolve_workers(n_workers),
+            n_rounds=n_rounds, seed=seed, scheme=scheme))
+
+    def incomplete(self, A, B=None, *, n_pairs: int, seed: int = 0) -> float:
+        """U~_B — B tuples sampled with replacement [SURVEY §1.2.4]."""
+        if n_pairs < 1:
+            raise ValueError(f"n_pairs must be >= 1, got {n_pairs}")
+        A, B = self._prep(A, B)
+        return float(self.backend.incomplete(
+            A, B, n_pairs=n_pairs, seed=seed))

@@ -1,0 +1,163 @@
+"""Closed-form U-statistic variance via the Hoeffding decomposition.
+
+The paper's analysis machinery [SURVEY §1.1] — used as the statistical
+test oracle [SURVEY §5.1]: empirical variances from the Monte-Carlo
+harness must match these formulas on Gaussian data.
+
+Population zeta components (two-sample, degree (1,1)):
+    zeta_10 = Var( E[h(X,Y) | X] ),  zeta_01 = Var( E[h(X,Y) | Y] ),
+    zeta_11 = Var( h(X,Y) )
+    Var(U_n) = [ zeta_11 + (n2-1) zeta_10 + (n1-1) zeta_01 ] / (n1 n2)
+
+Incomplete U with B tuples drawn with replacement:
+    Var(U~_B) = Var(U_n) + (1/B) (zeta_11 - Var(U_n))     [SURVEY §1.1]
+
+Given data here is a *sample*, the zetas are estimated empirically
+(plug-in, blockwise); tests account for plug-in noise with tolerances.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from tuplewise_tpu.ops.kernels import Kernel, get_kernel
+
+_BLOCK = 4096
+
+
+def _pair_moments(kernel: Kernel, A, B) -> Tuple[np.ndarray, np.ndarray, float, float]:
+    """Blockwise row means, col means, overall mean, mean of h^2."""
+    n1, n2 = len(A), len(B)
+    row_sum = np.zeros(n1)
+    col_sum = np.zeros(n2)
+    sq_sum = 0.0
+    for i0 in range(0, n1, _BLOCK):
+        a = A[i0 : i0 + _BLOCK]
+        for j0 in range(0, n2, _BLOCK):
+            m = np.asarray(kernel.pair_matrix(a, B[j0 : j0 + _BLOCK], np))
+            row_sum[i0 : i0 + len(a)] += m.sum(axis=1)
+            col_sum[j0 : j0 + m.shape[1]] += m.sum(axis=0)
+            sq_sum += float(np.sum(m * m))
+    row_mean = row_sum / n2
+    col_mean = col_sum / n1
+    mean = float(row_sum.sum() / (n1 * n2))
+    return row_mean, col_mean, mean, sq_sum / (n1 * n2)
+
+
+def two_sample_zetas(kernel, A, B) -> Tuple[float, float, float]:
+    """Plug-in estimates of (zeta_10, zeta_01, zeta_11)."""
+    kernel = get_kernel(kernel)
+    row_mean, col_mean, mean, h2_mean = _pair_moments(kernel, A, B)
+    z10 = float(np.var(row_mean))
+    z01 = float(np.var(col_mean))
+    z11 = h2_mean - mean**2
+    return z10, z01, max(z11, 0.0)
+
+
+def two_sample_variance_from_zetas(zetas, n1: int, n2: int) -> float:
+    z10, z01, z11 = zetas
+    return (z11 + (n2 - 1) * z10 + (n1 - 1) * z01) / (n1 * n2)
+
+
+def two_sample_variance(kernel, A, B) -> float:
+    """Var(U_n) for the complete two-sample U-statistic [SURVEY §1.1]."""
+    return two_sample_variance_from_zetas(
+        two_sample_zetas(kernel, A, B), len(A), len(B)
+    )
+
+
+def one_sample_zetas(kernel, A) -> Tuple[float, float]:
+    """(zeta_1, zeta_2) for a symmetric one-sample degree-2 kernel."""
+    kernel = get_kernel(kernel)
+    n = len(A)
+    row_sum = np.zeros(n)
+    sq_sum = 0.0
+    diag = np.zeros(n)
+    diag_sq = 0.0
+    for i0 in range(0, n, _BLOCK):
+        a = A[i0 : i0 + _BLOCK]
+        for j0 in range(0, n, _BLOCK):
+            m = np.asarray(kernel.pair_matrix(a, A[j0 : j0 + _BLOCK], np))
+            if i0 == j0:
+                d = np.diagonal(m).copy()
+                diag[i0 : i0 + len(d)] = d
+                diag_sq += float(np.sum(d * d))
+            row_sum[i0 : i0 + len(a)] += m.sum(axis=1)
+            sq_sum += float(np.sum(m * m))
+    # exclude the diagonal (i != j)
+    row_mean = (row_sum - diag) / (n - 1)
+    total = row_sum.sum() - diag.sum()
+    mean = total / (n * (n - 1))
+    h2_mean = (sq_sum - diag_sq) / (n * (n - 1))
+    z1 = float(np.var(row_mean))
+    z2 = max(h2_mean - mean**2, 0.0)
+    return z1, z2
+
+
+def one_sample_variance_from_zetas(zetas, n: int) -> float:
+    z1, z2 = zetas
+    return (2.0 / (n * (n - 1))) * (2.0 * (n - 2) * z1 + z2)
+
+
+def one_sample_variance(kernel, A) -> float:
+    """Var(U_n) = (2/(n(n-1))) [ 2(n-2) zeta_1 + zeta_2 ] [SURVEY §1.1]."""
+    return one_sample_variance_from_zetas(one_sample_zetas(kernel, A), len(A))
+
+
+def _zetas_and_sizes(kernel, A, B):
+    """One pair-grid sweep; everything below derives from it."""
+    kernel = get_kernel(kernel)
+    if kernel.two_sample:
+        return kernel, two_sample_zetas(kernel, A, B), (len(A), len(B))
+    return kernel, one_sample_zetas(kernel, A), (len(A),)
+
+
+def _complete_var(kernel, zetas, sizes) -> float:
+    if kernel.two_sample:
+        return two_sample_variance_from_zetas(zetas, *sizes)
+    return one_sample_variance_from_zetas(zetas, sizes[0])
+
+
+def _local_var(kernel, zetas, sizes, n_workers: int) -> float:
+    """Var(U^loc_N) under proportional SWOR partitioning, fresh-draw
+    approximation (accurate up to O(1/n) partition-coupling terms):
+    each worker holds n/N points, workers treated independent, so
+    Var = Var(U_{n/N}) / N [SURVEY §1.2 item 2]."""
+    per = tuple(s // n_workers for s in sizes)
+    return _complete_var(kernel, zetas, per) / n_workers
+
+
+def incomplete_variance(kernel, A, B=None, *, n_pairs: int) -> float:
+    """Var of the incomplete U-statistic with B tuples drawn with
+    replacement: Var(U_n) + (zeta_11 - Var(U_n)) / B [SURVEY §1.1]."""
+    kernel, zetas, sizes = _zetas_and_sizes(kernel, A, B)
+    var_u = _complete_var(kernel, zetas, sizes)
+    z_full = zetas[-1]  # zeta_11 (two-sample) / zeta_2 (one-sample)
+    return var_u + (z_full - var_u) / n_pairs
+
+
+def local_average_variance(kernel, A, B=None, *, n_workers: int) -> float:
+    """Var(U^loc_N) — see :func:`_local_var` [SURVEY §1.2 item 2]."""
+    kernel, zetas, sizes = _zetas_and_sizes(kernel, A, B)
+    return _local_var(kernel, zetas, sizes, n_workers)
+
+
+def repartitioned_variance(
+    kernel, A, B=None, *, n_workers: int, n_rounds: int
+) -> float:
+    """Var(U_{N,T}) for T SWOR repartition rounds [SURVEY §1.2 item 3].
+
+    Decompose Var(U^loc_N) = Var(U_n) + extra, where `extra` is the
+    variance added by ignoring cross-worker tuples. Fresh reshuffles
+    redraw the partition but NOT the data, so the U_n component is common
+    across rounds while `extra` averages down:
+        Var(U_{N,T}) ~= Var(U_n) + extra / T
+    — the trade-off curve in the paper's title.
+    """
+    kernel, zetas, sizes = _zetas_and_sizes(kernel, A, B)
+    var_complete = _complete_var(kernel, zetas, sizes)
+    var_loc = _local_var(kernel, zetas, sizes, n_workers)
+    extra = max(var_loc - var_complete, 0.0)
+    return var_complete + extra / n_rounds

@@ -1,0 +1,3 @@
+from tuplewise_tpu.models.scorers import LinearScorer, MLPScorer, init_scorer
+
+__all__ = ["LinearScorer", "MLPScorer", "init_scorer"]

@@ -1,0 +1,60 @@
+"""Scoring models s_theta for pairwise ranking [SURVEY §1.3].
+
+The paper's experiments use a linear scorer; an MLP is included so the
+learner generalizes beyond it. Models are pure-functional: parameters are
+pytrees (dicts of arrays), ``apply(params, X, xp)`` works under both
+NumPy (oracle) and JAX (jit/grad/vmap) — the same dual-namespace pattern
+as the kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearScorer:
+    """s(x) = x @ w + b."""
+
+    dim: int
+
+    def init(self, seed: int = 0) -> Params:
+        rng = np.random.default_rng(seed)
+        return {
+            "w": rng.standard_normal(self.dim) / np.sqrt(self.dim),
+            "b": np.zeros(()),
+        }
+
+    def apply(self, params: Params, X, xp) -> Any:
+        return X @ params["w"] + params["b"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPScorer:
+    """Two-layer tanh MLP scorer: s(x) = v @ tanh(x @ W1 + b1) + c."""
+
+    dim: int
+    hidden: int = 32
+
+    def init(self, seed: int = 0) -> Params:
+        rng = np.random.default_rng(seed)
+        return {
+            "W1": rng.standard_normal((self.dim, self.hidden)) / np.sqrt(self.dim),
+            "b1": np.zeros(self.hidden),
+            "v": rng.standard_normal(self.hidden) / np.sqrt(self.hidden),
+            "c": np.zeros(()),
+        }
+
+    def apply(self, params: Params, X, xp) -> Any:
+        h = xp.tanh(X @ params["W1"] + params["b1"])
+        return h @ params["v"] + params["c"]
+
+
+def init_scorer(name: str, dim: int, seed: int = 0, **kw):
+    scorer = {"linear": LinearScorer, "mlp": MLPScorer}[name](dim, **kw)
+    return scorer, scorer.init(seed)

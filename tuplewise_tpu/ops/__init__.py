@@ -1,0 +1,21 @@
+from tuplewise_tpu.ops.kernels import (
+    Kernel,
+    auc_kernel,
+    hinge_kernel,
+    logistic_kernel,
+    scatter_kernel,
+    triplet_hinge_kernel,
+    triplet_indicator_kernel,
+    get_kernel,
+)
+
+__all__ = [
+    "Kernel",
+    "auc_kernel",
+    "hinge_kernel",
+    "logistic_kernel",
+    "scatter_kernel",
+    "triplet_hinge_kernel",
+    "triplet_indicator_kernel",
+    "get_kernel",
+]

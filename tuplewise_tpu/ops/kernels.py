@@ -1,0 +1,227 @@
+"""L1 — tuplewise kernels h.
+
+The tuplewise functions at the heart of every U-statistic [SURVEY §1.1, §3].
+Each kernel is a small pure function written against an array namespace
+``xp`` (``numpy`` or ``jax.numpy``), so the exact same definition powers the
+NumPy oracle backend and the JAX/TPU backends — this is the
+"kernel-callable plugin" boundary named by the north star (BASELINE.json:5).
+
+Kernel families
+---------------
+* **Score-difference kernels** (``kind="diff"``): two-sample degree-(1,1)
+  kernels of the form ``h(x, y) = g(s(x) - s(y))`` on scalar scores —
+  the AUC indicator and its hinge / logistic surrogates [SURVEY §1.1, §1.3].
+  Everything downstream only ever needs ``g`` applied to a *difference
+  matrix*, which is what lets the TPU path tile the pair computation
+  instead of materializing it.
+* **Pair feature kernels** (``kind="pair"``): general degree-2 kernels
+  ``h(x_i, x_j)`` on feature vectors (e.g. within-cluster point scatter,
+  the paper's one-sample example) [SURVEY §1.1].
+* **Triplet kernels** (``kind="triplet"``): degree-3 metric-learning
+  relative-similarity kernels ``h(anchor, positive, negative)``
+  [SURVEY §1.1 "Degree-3", BASELINE config 4]. We frame them as
+  degree-(2,1) two-sample statistics: (i, j) drawn without replacement
+  from the same-class sample X, k from the other-class sample Y,
+  ``h = penalty( d(x_i, y_k) - d(x_i, x_j) )``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+Array = Any  # numpy or jax.numpy ndarray
+
+
+def _softplus(xp, v):
+    """Numerically stable log(1 + exp(v))."""
+    return xp.logaddexp(0.0, v)
+
+
+@dataclasses.dataclass(frozen=True)
+class Kernel:
+    """A tuplewise kernel h, the plugin unit of the framework.
+
+    Attributes:
+      name: registry name.
+      degree: number of sample points h consumes (2 or 3).
+      two_sample: True for two-sample statistics (X vs Y, e.g. AUC);
+        False for one-sample (pairs within a single sample).
+      kind: "diff" (scalar-score difference kernels), "pair" (feature
+        pair kernels), or "triplet" (degree-3 feature kernels).
+      diff_fn: for kind="diff": ``g(d, xp)`` applied elementwise to a
+        score-difference array ``d = s_i - s_j``.
+      pair_fn: for kind="pair": ``h(a, b, xp)`` mapping feature blocks
+        ``a [m, d]``, ``b [k, d]`` to an ``[m, k]`` kernel matrix.
+      triplet_fn: for kind="triplet": ``h(a, p, n, xp)`` mapping anchor /
+        positive / negative feature blocks (broadcast-compatible leading
+        axes) to kernel values.
+      pair_elem_fn: for kind="pair": elementwise ``h(a_b, b_b, xp)`` on
+        matched rows (the incomplete-sampling fast path).
+      higher_is_better: metric orientation (True for AUC, False for losses).
+    """
+
+    name: str
+    degree: int
+    two_sample: bool
+    kind: str
+    diff_fn: Optional[Callable[..., Array]] = None
+    pair_fn: Optional[Callable[..., Array]] = None
+    triplet_fn: Optional[Callable[..., Array]] = None
+    pair_elem_fn: Optional[Callable[..., Array]] = None
+    higher_is_better: bool = True
+
+    # ---- evaluation helpers -------------------------------------------------
+    def diff(self, d: Array, xp) -> Array:
+        assert self.kind == "diff", self.name
+        return self.diff_fn(d, xp)
+
+    def pair_matrix(self, a: Array, b: Array, xp) -> Array:
+        """Kernel matrix between blocks: [m, k]."""
+        if self.kind == "diff":
+            # a, b are 1-d score blocks.
+            return self.diff_fn(a[:, None] - b[None, :], xp)
+        assert self.kind == "pair", self.name
+        return self.pair_fn(a, b, xp)
+
+    def triplet_values(self, a: Array, p: Array, n: Array, xp) -> Array:
+        assert self.kind == "triplet", self.name
+        return self.triplet_fn(a, p, n, xp)
+
+    def pair_elementwise(self, a: Array, b: Array, xp) -> Array:
+        """h on matched tuples: a[t] paired with b[t] (incomplete sampling)."""
+        if self.kind == "diff":
+            return self.diff_fn(a - b, xp)
+        assert self.kind == "pair" and self.pair_elem_fn is not None, self.name
+        return self.pair_elem_fn(a, b, xp)
+
+
+# ---------------------------------------------------------------------------
+# Score-difference kernels (degree 2)
+# ---------------------------------------------------------------------------
+
+def _auc_g(d, xp):
+    # h(x, y) = 1{s(x) > s(y)} + 0.5 * 1{s(x) = s(y)}   [SURVEY §1.1]
+    return xp.where(d > 0, 1.0, 0.0) + 0.5 * xp.where(d == 0, 1.0, 0.0)
+
+
+def _hinge_g(d, xp):
+    # Pairwise hinge surrogate l(d) = max(0, 1 - d)      [SURVEY §1.3]
+    return xp.maximum(0.0, 1.0 - d)
+
+
+def _logistic_g(d, xp):
+    # Pairwise logistic surrogate l(d) = log(1 + e^{-d}) [SURVEY §1.3]
+    return _softplus(xp, -d)
+
+
+auc_kernel = Kernel(
+    name="auc", degree=2, two_sample=True, kind="diff",
+    diff_fn=_auc_g, higher_is_better=True,
+)
+
+hinge_kernel = Kernel(
+    name="hinge", degree=2, two_sample=True, kind="diff",
+    diff_fn=_hinge_g, higher_is_better=False,
+)
+
+logistic_kernel = Kernel(
+    name="logistic", degree=2, two_sample=True, kind="diff",
+    diff_fn=_logistic_g, higher_is_better=False,
+)
+
+
+# ---------------------------------------------------------------------------
+# Feature pair kernels (degree 2, one-sample)
+# ---------------------------------------------------------------------------
+
+def _sqdist_matrix(a, b, xp):
+    """Squared euclidean distances between rows of a [m,d] and b [k,d]."""
+    a2 = xp.sum(a * a, axis=-1)
+    b2 = xp.sum(b * b, axis=-1)
+    cross = a @ b.T
+    d2 = a2[:, None] + b2[None, :] - 2.0 * cross
+    return xp.maximum(d2, 0.0)
+
+
+def _scatter_h(a, b, xp):
+    # Within-cluster point scatter h(x, x') = ||x - x'||^2 / 2
+    # (the paper's one-sample degree-2 example) [SURVEY §1.1].
+    return 0.5 * _sqdist_matrix(a, b, xp)
+
+
+def _scatter_h_elem(a, b, xp):
+    diff = a - b
+    return 0.5 * xp.sum(diff * diff, axis=-1)
+
+
+scatter_kernel = Kernel(
+    name="scatter", degree=2, two_sample=False, kind="pair",
+    pair_fn=_scatter_h, pair_elem_fn=_scatter_h_elem, higher_is_better=False,
+)
+
+
+# ---------------------------------------------------------------------------
+# Triplet kernels (degree 3) — metric-learning relative similarity
+# ---------------------------------------------------------------------------
+
+def _sqdist_vec(a, b, xp):
+    diff = a - b
+    return xp.sum(diff * diff, axis=-1)
+
+
+def _triplet_indicator(a, p, n, xp, margin=0.0):
+    # 1{ d(anchor, negative) > d(anchor, positive) + margin }
+    return xp.where(
+        _sqdist_vec(a, n, xp) > _sqdist_vec(a, p, xp) + margin, 1.0, 0.0
+    )
+
+
+def _triplet_hinge(a, p, n, xp, margin=1.0):
+    # max(0, margin + d(anchor, positive) - d(anchor, negative))
+    return xp.maximum(
+        0.0, margin + _sqdist_vec(a, p, xp) - _sqdist_vec(a, n, xp)
+    )
+
+
+triplet_indicator_kernel = Kernel(
+    name="triplet_indicator", degree=3, two_sample=True, kind="triplet",
+    triplet_fn=_triplet_indicator, higher_is_better=True,
+)
+
+triplet_hinge_kernel = Kernel(
+    name="triplet_hinge", degree=3, two_sample=True, kind="triplet",
+    triplet_fn=_triplet_hinge, higher_is_better=False,
+)
+
+
+_REGISTRY = {
+    k.name: k
+    for k in [
+        auc_kernel,
+        hinge_kernel,
+        logistic_kernel,
+        scatter_kernel,
+        triplet_indicator_kernel,
+        triplet_hinge_kernel,
+    ]
+}
+
+
+def get_kernel(name_or_kernel) -> Kernel:
+    """Resolve a kernel by registry name, passing Kernel instances through."""
+    if isinstance(name_or_kernel, Kernel):
+        return name_or_kernel
+    try:
+        return _REGISTRY[name_or_kernel]
+    except KeyError:
+        raise KeyError(
+            f"unknown kernel {name_or_kernel!r}; "
+            f"available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def register_kernel(kernel: Kernel) -> Kernel:
+    """Register a user-defined kernel (the plugin entry point)."""
+    _REGISTRY[kernel.name] = kernel
+    return kernel

@@ -1,0 +1,17 @@
+# NOTE: tuplewise_tpu.parallel.mesh is intentionally NOT imported here —
+# it imports jax at module top, and the numpy oracle path must stay
+# importable without jax. Use `from tuplewise_tpu.parallel.mesh import
+# make_mesh, shard_axis_name` directly.
+from tuplewise_tpu.parallel.partition import (
+    partition_indices,
+    partition_two_sample,
+    pack_shards,
+    pack_two_sample_shards,
+)
+
+__all__ = [
+    "partition_indices",
+    "partition_two_sample",
+    "pack_shards",
+    "pack_two_sample_shards",
+]

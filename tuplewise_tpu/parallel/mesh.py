@@ -1,0 +1,29 @@
+"""Device-mesh construction for the distributed backend [SURVEY §5.8].
+
+One data shard per chip on a 1-D mesh; the mesh axis name ``"w"``
+("workers") is what `shard_map` bodies psum/ppermute over. Multi-chip
+code paths are validated without TPU hardware via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` [SURVEY §5.1].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+shard_axis_name = "w"
+
+
+def make_mesh(n_workers: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over ``n_workers`` devices (default: all local devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_workers is None:
+        n_workers = len(devices)
+    if n_workers > len(devices):
+        raise ValueError(
+            f"requested {n_workers} workers but only {len(devices)} devices"
+        )
+    return jax.make_mesh((n_workers,), (shard_axis_name,), devices=devices[:n_workers])

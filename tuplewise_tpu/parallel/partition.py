@@ -1,0 +1,118 @@
+"""L2 — partitioner / repartitioner.
+
+Splits sample indices across N workers and reshuffles them between rounds
+[SURVEY §2 L2, §3 "Partitioner / repartitioner"]. Schemes analyzed by the
+paper [SURVEY §1.2]:
+
+* ``"swor"`` — sampling WITHOUT replacement: one global permutation cut
+  into N equal blocks (remainder dropped so shapes stay static for XLA).
+* ``"swr"``  — sampling WITH replacement: each worker draws its block
+  i.i.d. uniformly from the full index range.
+* **proportional** (stratified) two-sample partitioning: each worker gets
+  an equal share of *each class*, which is what keeps the local-average
+  estimator well-defined and unbiased for two-sample statistics.
+
+These run on the host (NumPy): in the reference's in-process simulation
+they ARE the communication layer; in the TPU build they only decide the
+initial packing, while steady-state repartitioning happens on-device via
+`jax.random` permutations + XLA-inserted collectives
+(tuplewise_tpu.backends.mesh_backend).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def partition_indices(
+    n: int,
+    n_workers: int,
+    rng: np.random.Generator,
+    scheme: str = "swor",
+) -> np.ndarray:
+    """Partition ``range(n)`` into ``n_workers`` equal blocks.
+
+    Returns an int array of shape [n_workers, n // n_workers]; with
+    ``"swor"`` the blocks are disjoint (remainder indices dropped),
+    with ``"swr"`` each entry is an i.i.d. uniform draw.
+    """
+    per = n // n_workers
+    if per == 0:
+        raise ValueError(f"n={n} too small for {n_workers} workers")
+    if scheme == "swor":
+        perm = rng.permutation(n)[: per * n_workers]
+        return perm.reshape(n_workers, per)
+    if scheme == "swr":
+        return rng.integers(0, n, size=(n_workers, per))
+    raise ValueError(f"unknown partition scheme {scheme!r}")
+
+
+def partition_two_sample(
+    n_pos: int,
+    n_neg: int,
+    n_workers: int,
+    rng: np.random.Generator,
+    scheme: str = "swor",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Proportional (class-stratified) two-sample partition.
+
+    Each worker receives ``n_pos // N`` positives and ``n_neg // N``
+    negatives — the stratification required for unbiased local-average
+    estimation of two-sample U-statistics [SURVEY §1.2 item 2].
+
+    Returns (pos_idx [N, n_pos//N], neg_idx [N, n_neg//N]).
+    """
+    return (
+        partition_indices(n_pos, n_workers, rng, scheme),
+        partition_indices(n_neg, n_workers, rng, scheme),
+    )
+
+
+def pooled_partition(
+    y: np.ndarray,
+    n_workers: int,
+    rng: np.random.Generator,
+) -> List[np.ndarray]:
+    """NON-stratified pooled split (for studying what goes wrong without
+    proportional partitioning — a worker may end up with one class only).
+    Returns a ragged list of index arrays."""
+    n = len(y)
+    perm = rng.permutation(n)
+    return [perm[k::n_workers] for k in range(n_workers)]
+
+
+# ---------------------------------------------------------------------------
+# Packing for the device mesh: static [N, cap] blocks + validity masks
+# ---------------------------------------------------------------------------
+
+def pack_shards(
+    values: np.ndarray,
+    n_workers: int,
+    rng: np.random.Generator,
+    scheme: str = "swor",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Shard ``values`` (leading axis) into [N, cap, ...] blocks + mask.
+
+    XLA needs static shapes [SURVEY §7 "Hard parts"], so every shard holds
+    exactly ``cap = n // N`` rows; the mask is all-ones here but downstream
+    tile code is written mask-aware so padded packings compose.
+    """
+    idx = partition_indices(len(values), n_workers, rng, scheme)
+    packed = values[idx]
+    mask = np.ones(idx.shape, dtype=values.dtype if np.issubdtype(values.dtype, np.floating) else np.float64)
+    return packed, mask
+
+
+def pack_two_sample_shards(
+    pos: np.ndarray,
+    neg: np.ndarray,
+    n_workers: int,
+    rng: np.random.Generator,
+    scheme: str = "swor",
+):
+    """Stratified two-sample packing: ([N,c1,...], mask1, [N,c2,...], mask2)."""
+    p, mp = pack_shards(pos, n_workers, rng, scheme)
+    q, mq = pack_shards(neg, n_workers, rng, scheme)
+    return p, mp, q, mq
