@@ -1,8 +1,13 @@
-"""Force an 8-device CPU platform BEFORE jax initializes [SURVEY §5.1].
+"""Force an 8-device CPU platform BEFORE any jax computation [SURVEY §5.1].
 
 This is how the multi-chip code paths (mesh / psum / ppermute ring) run
 in CI with no TPU: XLA exposes 8 virtual CPU devices and the exact same
 shard_map code executes on them.
+
+NOTE: this environment PRELOADS jax at interpreter startup with
+``jax_platforms='axon,cpu'`` already set via config (the env var is
+ignored), so we must override through jax.config — and still set the env
+vars first for any subprocesses tests spawn.
 """
 
 import os
@@ -13,3 +18,11 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (preloaded anyway; see module docstring)
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()} — "
+    "jax was initialized before conftest could force the CPU platform"
+)

@@ -1,0 +1,140 @@
+"""JAX single-device backend: oracle parity [SURVEY §5.1].
+
+Complete statistics must match the NumPy oracle to float32 tolerance;
+randomized schemes (different PRNG) must agree statistically.
+"""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu import Estimator
+from tuplewise_tpu.data import make_gaussians
+
+
+@pytest.fixture(scope="module")
+def scores():
+    X, Y = make_gaussians(3000, 2500, dim=1, separation=1.0, seed=7)
+    return X[:, 0], Y[:, 0]
+
+
+@pytest.fixture(scope="module")
+def features():
+    rng = np.random.default_rng(0)
+    return rng.standard_normal((500, 4))
+
+
+class TestCompleteParity:
+    def test_auc(self, scores):
+        s1, s2 = scores
+        ref = Estimator("auc", backend="numpy").complete(s1, s2)
+        got = Estimator("auc", backend="jax", tile_a=256, tile_b=256).complete(s1, s2)
+        assert abs(got - ref) < 1e-6
+
+    def test_auc_non_tile_multiple(self, scores):
+        """Padding correctness: sizes not divisible by the tile."""
+        s1, s2 = scores
+        s1, s2 = s1[:1237], s2[:1019]
+        ref = Estimator("auc", backend="numpy").complete(s1, s2)
+        got = Estimator("auc", backend="jax", tile_a=256, tile_b=128).complete(s1, s2)
+        assert abs(got - ref) < 1e-6
+
+    def test_logistic(self, scores):
+        s1, s2 = scores
+        ref = Estimator("logistic", backend="numpy").complete(s1, s2)
+        got = Estimator("logistic", backend="jax", tile_a=512, tile_b=512).complete(s1, s2)
+        assert abs(got - ref) / abs(ref) < 1e-5
+
+    def test_one_sample_scatter(self, features):
+        ref = Estimator("scatter", backend="numpy").complete(features)
+        got = Estimator("scatter", backend="jax", tile_a=128, tile_b=128).complete(features)
+        assert abs(got - ref) / abs(ref) < 1e-5
+
+    def test_triplet(self, features):
+        X, Y = features[:60], features[60:100]
+        ref = Estimator("triplet_indicator", backend="numpy").complete(X, Y)
+        got = Estimator(
+            "triplet_indicator", backend="jax", triplet_tile=32
+        ).complete(X, Y)
+        assert abs(got - ref) < 1e-6
+
+
+class TestRandomizedSchemes:
+    def test_local_average_unbiased(self, scores):
+        s1, s2 = scores
+        s1, s2 = s1[:400], s2[:400]
+        est = Estimator("auc", backend="jax", n_workers=4,
+                        tile_a=128, tile_b=128)
+        u_n = est.complete(s1, s2)
+        vals = [est.local_average(s1, s2, seed=m) for m in range(60)]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u_n) < 4 * se + 1e-4
+
+    def test_local_average_swr_one_sample_unbiased(self, features):
+        A = features[:160]
+        est = Estimator("scatter", backend="jax", n_workers=4,
+                        tile_a=64, tile_b=64)
+        u_n = est.complete(A)
+        vals = [est.local_average(A, seed=m, scheme="swr") for m in range(120)]
+        se = np.std(vals) / np.sqrt(len(vals))
+        bias_if_broken = u_n / len(A)
+        assert se < bias_if_broken  # enough power to notice gross bias
+        assert abs(np.mean(vals) - u_n) < 4 * se
+
+    def test_repartitioned_matches_complete_in_mean(self, scores):
+        s1, s2 = scores
+        s1, s2 = s1[:256], s2[:256]
+        est = Estimator("auc", backend="jax", n_workers=4,
+                        tile_a=64, tile_b=64)
+        u_n = est.complete(s1, s2)
+        vals = [
+            est.repartitioned(s1, s2, n_rounds=4, seed=m) for m in range(40)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u_n) < 4 * se + 1e-4
+
+    def test_incomplete_unbiased(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="jax", tile_a=256, tile_b=256)
+        u_n = est.complete(s1, s2)
+        vals = [
+            est.incomplete(s1, s2, n_pairs=2000, seed=m) for m in range(100)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u_n) < 4 * se + 1e-4
+
+    def test_triplet_incomplete_unbiased(self, features):
+        X, Y = features[:60], features[60:100]
+        est = Estimator("triplet_indicator", backend="jax", triplet_tile=32)
+        u_n = est.complete(X, Y)
+        vals = [est.incomplete(X, Y, n_pairs=1000, seed=m) for m in range(80)]
+        se = np.std(vals) / np.sqrt(len(vals))
+        assert abs(np.mean(vals) - u_n) < 4 * se + 1e-3
+
+
+class TestGradients:
+    def test_pair_mean_grad_matches_dense(self):
+        """jax.grad through the tiled (checkpointed) reduction equals the
+        gradient of the dense O(n1*n2) computation."""
+        import jax
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pair_tiles
+        from tuplewise_tpu.ops.kernels import logistic_kernel
+
+        rng = np.random.default_rng(2)
+        s1 = jnp.asarray(rng.standard_normal(75), jnp.float32)
+        s2 = jnp.asarray(rng.standard_normal(53), jnp.float32)
+
+        def tiled_loss(a, b):
+            return pair_tiles.pair_mean(
+                logistic_kernel, a, b, tile_a=32, tile_b=16
+            )
+
+        def dense_loss(a, b):
+            d = a[:, None] - b[None, :]
+            return jnp.mean(jnp.logaddexp(0.0, -d))
+
+        g_tiled = jax.grad(tiled_loss, argnums=(0, 1))(s1, s2)
+        g_dense = jax.grad(dense_loss, argnums=(0, 1))(s1, s2)
+        np.testing.assert_allclose(g_tiled[0], g_dense[0], rtol=2e-5, atol=1e-7)
+        np.testing.assert_allclose(g_tiled[1], g_dense[1], rtol=2e-5, atol=1e-7)

@@ -1,0 +1,179 @@
+"""Single-device JAX/XLA backend [SURVEY §7 step 4].
+
+Same estimator semantics as the NumPy oracle, executed as compiled XLA:
+
+* pair/triplet sums stream through the tiled reductions in
+  ops.pair_tiles (never materializing the grid);
+* the N simulated workers of local-average / repartitioned schemes are a
+  `jax.vmap` axis — the single-device rehearsal of the mesh backend's
+  one-shard-per-chip layout;
+* partitioning/repartitioning and incomplete sampling use `jax.random`
+  with the fold_in key discipline of utils.rng;
+* every entry point is `jax.jit`-compiled and cached per input shape.
+
+Parity contract with the oracle [SURVEY §5.1]: exact (to dtype) for
+complete statistics; statistical for anything that draws randomness,
+since NumPy and JAX PRNGs cannot match bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tuplewise_tpu.backends.base import register_backend
+from tuplewise_tpu.ops import pair_tiles
+from tuplewise_tpu.ops.kernels import Kernel, get_kernel
+from tuplewise_tpu.utils.rng import fold, root_key
+
+
+@register_backend("jax")
+class JaxBackend:
+    """Single-device XLA execution of the four estimator schemes."""
+
+    name = "jax"
+
+    def __init__(
+        self,
+        kernel: Kernel,
+        dtype=jnp.float32,
+        tile_a: int = 1024,
+        tile_b: int = 1024,
+        triplet_tile: int = 128,
+    ):
+        self.kernel = get_kernel(kernel)
+        self.dtype = dtype
+        self.tile_a, self.tile_b = tile_a, tile_b
+        self.triplet_tile = triplet_tile
+        k = self.kernel
+
+        # ---- complete ------------------------------------------------- #
+        def complete_fn(A, B):
+            if k.kind == "triplet":
+                s, c = pair_tiles.triplet_stats(k, A, B, tile=triplet_tile)
+            elif k.two_sample:
+                s, c = pair_tiles.pair_stats(
+                    k, A, B, tile_a=tile_a, tile_b=tile_b
+                )
+            else:
+                ids = jnp.arange(A.shape[0], dtype=jnp.int32)
+                s, c = pair_tiles.pair_stats(
+                    k, A, A, ids_a=ids, ids_b=ids,
+                    tile_a=tile_a, tile_b=tile_b,
+                )
+            return s / c.astype(s.dtype)
+
+        self._complete = jax.jit(complete_fn)
+
+        # ---- local average over a random partition -------------------- #
+        def draw_blocks(key, n, n_workers, scheme):
+            m = n // n_workers
+            if scheme == "swor":
+                idx = jax.random.permutation(key, n)[: n_workers * m]
+                return idx.reshape(n_workers, m)
+            return jax.random.randint(key, (n_workers, m), 0, n)
+
+        def local_round(A, B, key, n_workers, scheme):
+            """One local-average round; workers are a vmap axis."""
+            if k.two_sample:  # incl. triplet (degree-(2,1))
+                k1, k2 = jax.random.split(key)
+                i1 = draw_blocks(k1, A.shape[0], n_workers, scheme)
+                i2 = draw_blocks(k2, B.shape[0], n_workers, scheme)
+                Ab, Bb = A[i1], B[i2]
+                if k.kind == "triplet":
+                    def worker(a, b, ids):
+                        s, c = pair_tiles.triplet_stats(
+                            k, a, b, ids_x=ids, tile=triplet_tile
+                        )
+                        return s / c.astype(s.dtype)
+                    vals = jax.vmap(worker)(Ab, Bb, i1.astype(jnp.int32))
+                else:
+                    def worker(a, b):
+                        s, c = pair_tiles.pair_stats(
+                            k, a, b, tile_a=tile_a, tile_b=tile_b
+                        )
+                        return s / c.astype(s.dtype)
+                    vals = jax.vmap(worker)(Ab, Bb)
+            else:
+                idx = draw_blocks(key, A.shape[0], n_workers, scheme)
+                Ab = A[idx]
+                def worker(a, ids):
+                    s, c = pair_tiles.pair_stats(
+                        k, a, a, ids_a=ids, ids_b=ids,
+                        tile_a=tile_a, tile_b=tile_b,
+                    )
+                    return s / c.astype(s.dtype)
+                vals = jax.vmap(worker)(Ab, idx.astype(jnp.int32))
+            return jnp.mean(vals)
+
+        self._local = jax.jit(
+            local_round, static_argnames=("n_workers", "scheme")
+        )
+
+        # ---- repartitioned: scan over T reshuffle rounds -------------- #
+        def repartitioned_fn(A, B, key, n_workers, n_rounds, scheme):
+            def round_body(carry, t):
+                kt = fold(key, "repartition_round", t)
+                return carry + local_round(A, B, kt, n_workers, scheme), None
+
+            total, _ = lax.scan(
+                round_body, jnp.zeros((), A.dtype), jnp.arange(n_rounds)
+            )
+            return total / n_rounds
+
+        self._repart = jax.jit(
+            repartitioned_fn,
+            static_argnames=("n_workers", "n_rounds", "scheme"),
+        )
+
+        # ---- incomplete ----------------------------------------------- #
+        def incomplete_fn(A, B, key, n_pairs):
+            if k.kind == "triplet":
+                return pair_tiles.incomplete_triplet_mean(k, key, A, B, n_pairs)
+            if k.two_sample:
+                return pair_tiles.incomplete_pair_mean(
+                    k, key, A, B, n_pairs, one_sample=False
+                )
+            return pair_tiles.incomplete_pair_mean(
+                k, key, A, A, n_pairs, one_sample=True
+            )
+
+        self._incomplete = jax.jit(
+            incomplete_fn, static_argnames=("n_pairs",)
+        )
+
+    # ------------------------------------------------------------------ #
+    def _dev(self, A, B):
+        A = jnp.asarray(A, self.dtype)
+        B = None if B is None else jnp.asarray(B, self.dtype)
+        return A, B
+
+    def complete(self, A, B=None) -> float:
+        A, B = self._dev(A, B)
+        return float(self._complete(A, B if B is not None else A)
+                     if self.kernel.two_sample else self._complete(A, A))
+
+    def local_average(self, A, B=None, *, n_workers, seed=0, scheme="swor"):
+        A, B = self._dev(A, B)
+        key = fold(root_key(seed), "local_average")
+        return float(self._local(
+            A, B if B is not None else A, key,
+            n_workers=n_workers, scheme=scheme))
+
+    def repartitioned(self, A, B=None, *, n_workers, n_rounds,
+                      seed=0, scheme="swor"):
+        A, B = self._dev(A, B)
+        key = root_key(seed)
+        return float(self._repart(
+            A, B if B is not None else A, key,
+            n_workers=n_workers, n_rounds=n_rounds, scheme=scheme))
+
+    def incomplete(self, A, B=None, *, n_pairs, seed=0):
+        A, B = self._dev(A, B)
+        key = fold(root_key(seed), "incomplete")
+        return float(self._incomplete(
+            A, B if B is not None else A, key, n_pairs=n_pairs))

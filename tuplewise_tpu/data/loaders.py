@@ -51,6 +51,9 @@ def load_adult(
         if c and os.path.exists(c):
             blob = np.load(c)
             X, y = np.asarray(blob["X"], float), np.asarray(blob["y"], int)
+            if len(X) > n:  # honor the requested size on real data too
+                keep = np.random.default_rng(seed).choice(len(X), n, replace=False)
+                X, y = X[keep], y[keep]
             X = (X - X.mean(0)) / (X.std(0) + 1e-12)
             return X, y, {"synthetic": False, "source": c}
 
@@ -90,6 +93,9 @@ def load_mnist_embeddings(
             blob = np.load(c)
             E = np.asarray(blob["E"], float)
             labels = np.asarray(blob["labels"], int)
+            if len(E) > n:  # honor the requested size on real data too
+                keep = np.random.default_rng(seed).choice(len(E), n, replace=False)
+                E, labels = E[keep], labels[keep]
             return E, labels, {"synthetic": False, "source": c}
 
     rng = np.random.default_rng(seed + 60283)

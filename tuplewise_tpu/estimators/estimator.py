@@ -50,9 +50,19 @@ class Estimator:
         return n
 
     def _prep(self, A, B):
+        """Validate shapes. Only the numpy oracle forces a host float64
+        copy; device backends receive the input as-is (so jax arrays stay
+        on device) and cast to their compute dtype themselves."""
         k = self.kernel
-        A = np.asarray(A, dtype=np.float64)
-        B = None if B is None else np.asarray(B, dtype=np.float64)
+
+        def cast(x):
+            if x is None:
+                return None
+            if self.backend_name == "numpy":
+                return np.asarray(x, dtype=np.float64)
+            return x if hasattr(x, "ndim") else np.asarray(x)
+
+        A, B = cast(A), cast(B)
         if k.two_sample and B is None:
             raise ValueError(f"kernel {k.name!r} is two-sample: pass (A, B)")
         if not k.two_sample and B is not None:

@@ -126,6 +126,12 @@ def _local_var(kernel, zetas, sizes, n_workers: int) -> float:
     each worker holds n/N points, workers treated independent, so
     Var = Var(U_{n/N}) / N [SURVEY §1.2 item 2]."""
     per = tuple(s // n_workers for s in sizes)
+    if min(per) < 2:
+        raise ValueError(
+            f"n_workers={n_workers} leaves per-worker sample sizes {per}; "
+            "need at least 2 points per worker and class for a local "
+            "U-statistic"
+        )
     return _complete_var(kernel, zetas, per) / n_workers
 
 

@@ -1,0 +1,269 @@
+"""Tiled tuplewise reductions for XLA — the hot loop of the framework.
+
+The complete U-statistic at n=10^7 touches ~10^14 pairs; the pair grid is
+NEVER materialized [SURVEY §7 "Hard parts"]. Instead inputs are padded to
+tile multiples and reduced with nested `lax.scan` over (tile_a x tile_b)
+blocks: per-step memory is one block, per-step compute is a dense
+vectorized kernel evaluation (elementwise VPU work for score kernels, an
+MXU matmul for feature kernels via a @ b.T inside sqdist).
+
+Reductions are mask- and id-aware:
+* masks make padded/stratified packings exact (renormalize by the true
+  pair count inside the reduction [SURVEY §7 "Proportional sharding"]);
+* ids exclude coincident original indices, which keeps one-sample
+  statistics unbiased under with-replacement repartitioning (same
+  discipline as the NumPy oracle backend).
+
+Numerics: TPUs have no native float64 (and mixing f64 accumulators with
+MXU dots crashes this toolchain's compiler), so scalar accumulators use
+KAHAN-COMPENSATED float32 for kernel sums — an indicator kernel summed
+over >2^24 pairs would silently lose increments in plain f32 — and a
+split int32 (lo, hi) base-2^24 counter for pair counts, exact to 2^55
+pairs with no int64/float64 anywhere (this library does NOT touch the
+global x64 flag). Tile bodies are wrapped in `jax.checkpoint`, so
+`jax.grad` through a pair reduction re-streams tiles instead of storing
+the grid [SURVEY §7 "Hard parts"].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_COUNT_RADIX = 1 << 24  # tile counts must stay below this for exactness
+
+
+def _pad_axis0(x: jnp.ndarray, tile: int) -> jnp.ndarray:
+    n = x.shape[0]
+    rem = (-n) % tile
+    if rem == 0:
+        return x
+    pad = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+def _tiles(x: jnp.ndarray, tile: int) -> jnp.ndarray:
+    """[n, ...] -> [n_tiles, tile, ...] (zero-padded)."""
+    x = _pad_axis0(x, tile)
+    return x.reshape((x.shape[0] // tile, tile) + x.shape[1:])
+
+
+def _kahan_add(s, comp, x):
+    """One compensated-summation step; linear, hence cleanly differentiable."""
+    y = x - comp
+    t = s + y
+    comp = (t - s) - y
+    return t, comp
+
+
+def _acc_init(dtype):
+    return (
+        jnp.zeros((), dtype),           # kahan sum
+        jnp.zeros((), dtype),           # kahan compensation
+        jnp.zeros((), jnp.int32),       # count low digit (base 2^24)
+        jnp.zeros((), jnp.int32),       # count high digit
+    )
+
+
+def _acc_update(carry, tile_sum, tile_count):
+    """tile_count is int32 < 2^24; the (lo, hi) pair stays exact to 2^55."""
+    s, comp, lo, hi = carry
+    s, comp = _kahan_add(s, comp, tile_sum)
+    lo = lo + tile_count
+    carry_digit = lo >> 24
+    return (s, comp, lo - (carry_digit << 24), hi + carry_digit)
+
+
+def _acc_final(carry) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum, count) with count reconstructed in the sum's dtype.
+
+    The reconstruction rounds to f32 (relative error ~1e-7 past 2^24
+    pairs) — negligible against the f32 storage of the sum itself.
+    """
+    s, comp, lo, hi = carry
+    total = s + comp
+    count = hi.astype(s.dtype) * s.dtype.type(_COUNT_RADIX) + lo.astype(s.dtype)
+    return total, count
+
+
+def pair_stats(
+    kernel,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    mask_a: Optional[jnp.ndarray] = None,
+    mask_b: Optional[jnp.ndarray] = None,
+    ids_a: Optional[jnp.ndarray] = None,
+    ids_b: Optional[jnp.ndarray] = None,
+    *,
+    tile_a: int = 1024,
+    tile_b: int = 1024,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum, count) of h over the masked A x B grid, streamed in tiles.
+
+    Args:
+      A, B: [n1(, d)], [n2(, d)] score vectors or feature matrices.
+      mask_a/mask_b: optional {0,1} float validity masks.
+      ids_a/ids_b: optional int original-index arrays; grid cells with
+        ids_a[i] == ids_b[j] are excluded (one-sample diagonal and
+        with-replacement duplicates).
+
+    Returns (weighted_sum, count), both scalars in A's dtype; the caller
+    divides. Differentiable w.r.t. A/B (tiles checkpointed).
+    """
+    if tile_a * tile_b >= _COUNT_RADIX:
+        raise ValueError(
+            f"tile_a*tile_b = {tile_a * tile_b} must be < 2^24 "
+            "for exact pair counting"
+        )
+    use_ids = ids_a is not None
+    dtype = A.dtype
+    ma = jnp.ones(A.shape[0], dtype) if mask_a is None else mask_a
+    mb = jnp.ones(B.shape[0], dtype) if mask_b is None else mask_b
+
+    a_t, ma_t = _tiles(A, tile_a), _tiles(ma, tile_a)
+    b_t, mb_t = _tiles(B, tile_b), _tiles(mb, tile_b)
+    if use_ids:
+        ia_t = _tiles(ids_a.astype(jnp.int32), tile_a)
+        ib_t = _tiles(ids_b.astype(jnp.int32), tile_b)
+    else:  # dummies keep the scan signature static
+        ia_t = jnp.zeros(a_t.shape[:2], jnp.int32)
+        ib_t = jnp.zeros(b_t.shape[:2], jnp.int32)
+
+    @jax.checkpoint
+    def tile_term(a, ma_, ia, b, mb_, ib):
+        vals = kernel.pair_matrix(a, b, jnp)
+        w = ma_[:, None] * mb_[None, :]
+        if use_ids:
+            w = w * (ia[:, None] != ib[None, :]).astype(dtype)
+        tile_sum = jnp.sum(vals * w, dtype=dtype)
+        tile_count = jnp.sum(w > 0, dtype=jnp.int32)
+        return tile_sum, tile_count
+
+    def inner(carry, xs_b, a, ma_, ia):
+        b, mb_, ib = xs_b
+        ds, dc = tile_term(a, ma_, ia, b, mb_, ib)
+        return _acc_update(carry, ds, dc), None
+
+    def outer(carry, xs_a):
+        a, ma_, ia = xs_a
+        out, _ = lax.scan(
+            functools.partial(inner, a=a, ma_=ma_, ia=ia),
+            carry,
+            (b_t, mb_t, ib_t),
+        )
+        return out, None
+
+    carry, _ = lax.scan(outer, _acc_init(dtype), (a_t, ma_t, ia_t))
+    return _acc_final(carry)
+
+
+def pair_mean(kernel, A, B, **kw) -> jnp.ndarray:
+    s, c = pair_stats(kernel, A, B, **kw)
+    return s / c.astype(s.dtype)
+
+
+def triplet_stats(
+    kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    mask_x: Optional[jnp.ndarray] = None,
+    mask_y: Optional[jnp.ndarray] = None,
+    ids_x: Optional[jnp.ndarray] = None,
+    *,
+    tile: int = 128,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum, count) of h(x_i, x_j, y_k) over i != j (by id), all k.
+
+    Triple-nested tile scan; per-step block is [tile, tile, tile]
+    (default 128^3 = 2M values). Complete degree-3 runs only at small n
+    [SURVEY §7 step 7]; the incomplete sampler is the scalable path.
+    """
+    if tile**3 >= _COUNT_RADIX:
+        raise ValueError(
+            f"tile^3 = {tile**3} must be < 2^24 for exact tuple counting"
+        )
+    dtype = X.dtype
+    mx = jnp.ones(X.shape[0], dtype) if mask_x is None else mask_x
+    my = jnp.ones(Y.shape[0], dtype) if mask_y is None else mask_y
+    ix = (jnp.arange(X.shape[0]) if ids_x is None else ids_x).astype(jnp.int32)
+
+    x_t, mx_t, ix_t = _tiles(X, tile), _tiles(mx, tile), _tiles(ix, tile)
+    y_t, my_t = _tiles(Y, tile), _tiles(my, tile)
+
+    @jax.checkpoint
+    def tile_term(a, ma_, ia, p, mp_, ip, yk, mk_):
+        # [ta, tp, tk] block: anchors x positives x negatives
+        vals = kernel.triplet_values(
+            a[:, None, None, :], p[None, :, None, :], yk[None, None, :, :], jnp
+        )
+        w = (
+            ma_[:, None, None]
+            * mp_[None, :, None]
+            * mk_[None, None, :]
+            * (ia[:, None, None] != ip[None, :, None]).astype(dtype)
+        )
+        return (
+            jnp.sum(vals * w, dtype=dtype),
+            jnp.sum(w > 0, dtype=jnp.int32),
+        )
+
+    def scan_k(carry, xs_k, a, ma_, ia, p, mp_, ip):
+        yk, mk_ = xs_k
+        ds, dc = tile_term(a, ma_, ia, p, mp_, ip, yk, mk_)
+        return _acc_update(carry, ds, dc), None
+
+    def scan_j(carry, xs_j, a, ma_, ia):
+        p, mp_, ip = xs_j
+        out, _ = lax.scan(
+            functools.partial(scan_k, a=a, ma_=ma_, ia=ia, p=p, mp_=mp_, ip=ip),
+            carry,
+            (y_t, my_t),
+        )
+        return out, None
+
+    def scan_i(carry, xs_i):
+        a, ma_, ia = xs_i
+        out, _ = lax.scan(
+            functools.partial(scan_j, a=a, ma_=ma_, ia=ia),
+            carry,
+            (x_t, mx_t, ix_t),
+        )
+        return out, None
+
+    carry, _ = lax.scan(scan_i, _acc_init(dtype), (x_t, mx_t, ix_t))
+    return _acc_final(carry)
+
+
+# ---------------------------------------------------------------------------
+# Incomplete (sampled) statistics [SURVEY §4.3]
+# ---------------------------------------------------------------------------
+
+def sample_pair_indices(key, n1: int, n2: int, n_pairs: int, one_sample: bool):
+    """B tuple indices drawn uniformly with replacement from the grid;
+    one-sample draws j from the off-diagonal (j != i) via the shift trick."""
+    ki, kj = jax.random.split(key)
+    i = jax.random.randint(ki, (n_pairs,), 0, n1)
+    if one_sample:
+        j = jax.random.randint(kj, (n_pairs,), 0, n2 - 1)
+        j = jnp.where(j >= i, j + 1, j)
+    else:
+        j = jax.random.randint(kj, (n_pairs,), 0, n2)
+    return i, j
+
+
+def incomplete_pair_mean(kernel, key, A, B, n_pairs: int, one_sample: bool):
+    i, j = sample_pair_indices(key, A.shape[0], B.shape[0], n_pairs, one_sample)
+    vals = kernel.pair_elementwise(A[i], B[j], jnp)
+    return jnp.mean(vals, dtype=A.dtype)
+
+
+def incomplete_triplet_mean(kernel, key, X, Y, n_pairs: int):
+    k1, k2 = jax.random.split(key)
+    i, j = sample_pair_indices(k1, X.shape[0], X.shape[0], n_pairs, True)
+    k = jax.random.randint(k2, (n_pairs,), 0, Y.shape[0])
+    vals = kernel.triplet_values(X[i], X[j], Y[k], jnp)
+    return jnp.mean(vals, dtype=X.dtype)

@@ -21,7 +21,7 @@ initial packing, while steady-state repartitioning happens on-device via
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Tuple
 
 import numpy as np
 
@@ -68,19 +68,6 @@ def partition_two_sample(
         partition_indices(n_pos, n_workers, rng, scheme),
         partition_indices(n_neg, n_workers, rng, scheme),
     )
-
-
-def pooled_partition(
-    y: np.ndarray,
-    n_workers: int,
-    rng: np.random.Generator,
-) -> List[np.ndarray]:
-    """NON-stratified pooled split (for studying what goes wrong without
-    proportional partitioning — a worker may end up with one class only).
-    Returns a ragged list of index arrays."""
-    n = len(y)
-    perm = rng.permutation(n)
-    return [perm[k::n_workers] for k in range(n_workers)]
 
 
 # ---------------------------------------------------------------------------
