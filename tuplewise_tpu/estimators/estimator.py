@@ -40,6 +40,10 @@ class Estimator:
         self.kernel = get_kernel(kernel)
         self.n_workers = int(n_workers)
         self.backend_name = backend
+        if (backend == "mesh" and "mesh" not in backend_opts
+                and "n_workers" not in backend_opts):
+            # one worker per chip: size the mesh from n_workers
+            backend_opts["n_workers"] = self.n_workers
         self.backend = get_backend(backend, self.kernel, **backend_opts)
 
     # ------------------------------------------------------------------ #

@@ -174,9 +174,16 @@ def triplet_stats(
     mask_y: Optional[jnp.ndarray] = None,
     ids_x: Optional[jnp.ndarray] = None,
     *,
+    positives: Optional[jnp.ndarray] = None,
+    mask_p: Optional[jnp.ndarray] = None,
+    ids_p: Optional[jnp.ndarray] = None,
     tile: int = 128,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(sum, count) of h(x_i, x_j, y_k) over i != j (by id), all k.
+    """(sum, count) of h(x_i, p_j, y_k) over ids_x[i] != ids_p[j], all k.
+
+    By default positives = X (the within-sample degree-(2,1) statistic);
+    the ring backend passes a *visiting* positives block instead, so the
+    same reduction serves single-device and cross-shard paths.
 
     Triple-nested tile scan; per-step block is [tile, tile, tile]
     (default 128^3 = 2M values). Complete degree-3 runs only at small n
@@ -190,8 +197,17 @@ def triplet_stats(
     mx = jnp.ones(X.shape[0], dtype) if mask_x is None else mask_x
     my = jnp.ones(Y.shape[0], dtype) if mask_y is None else mask_y
     ix = (jnp.arange(X.shape[0]) if ids_x is None else ids_x).astype(jnp.int32)
+    if positives is None:
+        positives, mp_, ip = X, mx, ix
+    else:
+        mp_ = jnp.ones(positives.shape[0], dtype) if mask_p is None else mask_p
+        ip = (jnp.arange(positives.shape[0]) if ids_p is None else ids_p
+              ).astype(jnp.int32)
 
     x_t, mx_t, ix_t = _tiles(X, tile), _tiles(mx, tile), _tiles(ix, tile)
+    p_all_t, mp_all_t, ip_all_t = (
+        _tiles(positives, tile), _tiles(mp_, tile), _tiles(ip, tile)
+    )
     y_t, my_t = _tiles(Y, tile), _tiles(my, tile)
 
     @jax.checkpoint
@@ -217,9 +233,9 @@ def triplet_stats(
         return _acc_update(carry, ds, dc), None
 
     def scan_j(carry, xs_j, a, ma_, ia):
-        p, mp_, ip = xs_j
+        p, mp2, ip2 = xs_j
         out, _ = lax.scan(
-            functools.partial(scan_k, a=a, ma_=ma_, ia=ia, p=p, mp_=mp_, ip=ip),
+            functools.partial(scan_k, a=a, ma_=ma_, ia=ia, p=p, mp_=mp2, ip=ip2),
             carry,
             (y_t, my_t),
         )
@@ -230,7 +246,7 @@ def triplet_stats(
         out, _ = lax.scan(
             functools.partial(scan_j, a=a, ma_=ma_, ia=ia),
             carry,
-            (x_t, mx_t, ix_t),
+            (p_all_t, mp_all_t, ip_all_t),
         )
         return out, None
 

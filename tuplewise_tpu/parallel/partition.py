@@ -74,6 +74,29 @@ def partition_two_sample(
 # Packing for the device mesh: static [N, cap] blocks + validity masks
 # ---------------------------------------------------------------------------
 
+def pack_all(values: np.ndarray, n_workers: int):
+    """Deterministically pack EVERY row into [N, cap, ...] + mask + ids.
+
+    Unlike :func:`pack_shards` (random partition, remainder dropped),
+    this keeps all n rows — cap = ceil(n / N), tail zero-padded with a
+    zero mask — which is what complete (all-pairs) statistics need.
+    Returns (packed, mask, ids) with ids = original row index (padding
+    gets id -1, excluded by masks anyway).
+    """
+    n = len(values)
+    cap = -(-n // n_workers)
+    pad = n_workers * cap - n
+    packed = np.concatenate(
+        [values, np.zeros((pad,) + values.shape[1:], values.dtype)]
+    ).reshape((n_workers, cap) + values.shape[1:])
+    mask = np.concatenate(
+        [np.ones(n), np.zeros(pad)]
+    ).reshape(n_workers, cap)
+    ids = np.concatenate(
+        [np.arange(n), np.full(pad, -1)]
+    ).astype(np.int32).reshape(n_workers, cap)
+    return packed, mask, ids
+
 def pack_shards(
     values: np.ndarray,
     n_workers: int,

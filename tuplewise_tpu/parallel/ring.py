@@ -1,0 +1,147 @@
+"""ring_pairs — cross-shard all-pairs over the ICI ring [SURVEY §5.7, §7 step 5].
+
+The build's signature primitive. Each chip holds one data shard; to touch
+every cross-shard pair, shard blocks rotate around the ring via
+`lax.ppermute` while each chip accumulates pair-kernel sums between its
+resident block and the visiting block — structurally the communication
+pattern of ring attention, applied to tuplewise kernels instead of
+attention [SURVEY §3 "Cross-shard pair computation", §5.7]. After N
+steps every (shard_i, shard_j) block pair has been visited exactly once;
+a final `lax.psum` yields the global sum.
+
+These functions run INSIDE `jax.shard_map` bodies: array arguments are
+per-shard local blocks, and `axis_name` names the mesh axis to rotate
+over. Compute between rotations is the tiled reduction of ops.pair_tiles,
+so each ppermute can overlap with a long tile loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tuplewise_tpu.ops import pair_tiles
+
+
+def _ring_perm(axis_name):
+    n = lax.axis_size(axis_name)
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_pair_stats(
+    kernel,
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    mask_a: Optional[jnp.ndarray] = None,
+    mask_b: Optional[jnp.ndarray] = None,
+    ids_a: Optional[jnp.ndarray] = None,
+    ids_b: Optional[jnp.ndarray] = None,
+    *,
+    axis_name: str,
+    tile_a: int = 1024,
+    tile_b: int = 1024,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Global (sum, count) of h over ALL cross- and within-shard pairs.
+
+    a, b: this shard's blocks of the two samples (one-sample statistics
+    pass the same block with its ids). The b-side block (with its mask
+    and ids) rotates around the ring; the a-side stays resident.
+
+    Returns the SAME (sum, count) on every shard (psum'd), equal to the
+    single-device pair_stats over the concatenated data — the ring
+    invariance property tested in tests/test_mesh_backend.py.
+    """
+    n_shards = lax.axis_size(axis_name)
+    dtype = a.dtype
+    mb = jnp.ones(b.shape[0], dtype) if mask_b is None else mask_b
+    use_ids = ids_a is not None
+    ib = jnp.zeros(b.shape[0], jnp.int32) if ids_b is None else ids_b.astype(jnp.int32)
+    perm = _ring_perm(axis_name)
+
+    def step(carry, _):
+        s, c, bv, mbv, ibv = carry
+        ds, dc = pair_tiles.pair_stats(
+            kernel, a, bv,
+            mask_a=mask_a, mask_b=mbv,
+            ids_a=ids_a if use_ids else None,
+            ids_b=ibv if use_ids else None,
+            tile_a=tile_a, tile_b=tile_b,
+        )
+        bv = lax.ppermute(bv, axis_name, perm)
+        mbv = lax.ppermute(mbv, axis_name, perm)
+        ibv = lax.ppermute(ibv, axis_name, perm)
+        return (s + ds, c + dc, bv, mbv, ibv), None
+
+    init = (jnp.zeros((), dtype), jnp.zeros((), dtype), b, mb, ib)
+    (s, c, _, _, _), _ = lax.scan(step, init, None, length=n_shards)
+    return lax.psum(s, axis_name), lax.psum(c, axis_name)
+
+
+def ring_triplet_stats(
+    kernel,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask_x: Optional[jnp.ndarray] = None,
+    mask_y: Optional[jnp.ndarray] = None,
+    ids_x: Optional[jnp.ndarray] = None,
+    *,
+    axis_name: str,
+    tile: int = 64,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Global (sum, count) of h(x_i, x_j, y_k) over ALL triplets with
+    i != j (by id) — a DOUBLE ring: the positives block x rotates in the
+    outer loop, and for each of its N positions the negatives block y
+    completes a full inner rotation, so all (shard_i, shard_j, shard_k)
+    block triples are visited (N^2 communication steps).
+
+    Anchors stay resident; O(N^2) ppermutes of small blocks ride the ICI
+    ring while each step runs the O(m^3) tile reduction.
+    """
+    n_shards = lax.axis_size(axis_name)
+    dtype = x.dtype
+    mx = jnp.ones(x.shape[0], dtype) if mask_x is None else mask_x
+    my = jnp.ones(y.shape[0], dtype) if mask_y is None else mask_y
+    ix = (jnp.arange(x.shape[0]) if ids_x is None else ids_x).astype(jnp.int32)
+    perm = _ring_perm(axis_name)
+
+    # anchors: resident block (x, mx, ix); positives: visiting (p); negatives: visiting (ynext)
+    def inner_step(carry, _, p, mp, ip):
+        s, c, yv, myv = carry
+        ds, dc = _triplet_block(kernel, x, mx, ix, p, mp, ip, yv, myv, tile)
+        yv = lax.ppermute(yv, axis_name, perm)
+        myv = lax.ppermute(myv, axis_name, perm)
+        return (s + ds, c + dc, yv, myv), None
+
+    def outer_step(carry, _):
+        s, c, p, mp, ip, yv, myv = carry
+        import functools
+
+        (s, c, yv, myv), _ = lax.scan(
+            functools.partial(inner_step, p=p, mp=mp, ip=ip),
+            (s, c, yv, myv),
+            None,
+            length=n_shards,
+        )
+        p = lax.ppermute(p, axis_name, perm)
+        mp = lax.ppermute(mp, axis_name, perm)
+        ip = lax.ppermute(ip, axis_name, perm)
+        return (s, c, p, mp, ip, yv, myv), None
+
+    init = (
+        jnp.zeros((), dtype), jnp.zeros((), dtype),
+        x, mx, ix, y, my,
+    )
+    (s, c, *_), _ = lax.scan(outer_step, init, None, length=n_shards)
+    return lax.psum(s, axis_name), lax.psum(c, axis_name)
+
+
+def _triplet_block(kernel, a, ma, ia, p, mp, ip, yk, mk, tile):
+    """One double-ring step: the generalized triplet reduction over
+    (resident anchors, visiting positives, visiting negatives)."""
+    return pair_tiles.triplet_stats(
+        kernel, a, yk, mask_x=ma, mask_y=mk, ids_x=ia,
+        positives=p, mask_p=mp, ids_p=ip, tile=tile,
+    )
