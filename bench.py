@@ -1,0 +1,96 @@
+"""Headline benchmark: pairs/sec/chip for the tiled U-statistic core.
+
+Prints ONE JSON line:
+  {"metric": "pairs/sec/chip", "value": N, "unit": "pairs/s", "vs_baseline": R}
+
+`value` is the complete-AUC pair-kernel throughput of the JAX/TPU tiled
+reduction on one chip (BASELINE.json:2's metric). The reference repo
+published no numbers (/root/reference was empty; BASELINE.md), so per
+SURVEY §6 the recorded baseline is the frozen NumPy oracle path measured
+on this same machine: vs_baseline = tpu_throughput / numpy_throughput.
+
+Diagnostics go to stderr; stdout carries exactly the one JSON line.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _tpu_pairs_per_sec(n=1 << 17, tile_a=1024, tile_b=8192, reps=3):
+    import jax
+    import jax.numpy as jnp
+
+    from tuplewise_tpu.ops import pair_tiles
+    from tuplewise_tpu.ops.kernels import auc_kernel
+
+    rng = np.random.default_rng(0)
+    # DISTINCT inputs per rep: the axon runtime can memoize repeated
+    # identical jitted calls, which makes same-input timing loops lie.
+    inputs = [
+        (
+            jnp.asarray(rng.standard_normal(n), jnp.float32),
+            jnp.asarray(rng.standard_normal(n), jnp.float32),
+        )
+        for _ in range(reps + 1)
+    ]
+    f = jax.jit(
+        lambda a, b: pair_tiles.pair_stats(
+            auc_kernel, a, b, tile_a=tile_a, tile_b=tile_b
+        )
+    )
+    float(f(*inputs[0])[0])  # compile; host transfer forces completion
+    # (block_until_ready alone does not reliably wait through the axon
+    # tunnel — time individual calls, each synced by a host read)
+    times = []
+    r = None
+    for inp in inputs[1:]:
+        t0 = time.perf_counter()
+        r = f(*inp)
+        float(r[0])
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    auc = float(r[0]) / float(r[1])
+    print(
+        f"[bench] device={jax.devices()[0]} n={n} dt={dt:.4f}s "
+        f"auc={auc:.4f}", file=sys.stderr,
+    )
+    return (n * n) / dt
+
+
+def _numpy_pairs_per_sec(n=16384, reps=3):
+    from tuplewise_tpu.backends.numpy_backend import NumpyBackend
+    from tuplewise_tpu.ops.kernels import auc_kernel
+
+    rng = np.random.default_rng(0)
+    s1 = rng.standard_normal(n)
+    s2 = rng.standard_normal(n)
+    be = NumpyBackend(auc_kernel)
+    be.complete(s1, s2)  # warm caches
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        be.complete(s1, s2)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"[bench] numpy oracle n={n} dt={dt:.4f}s", file=sys.stderr)
+    return (n * n) / dt
+
+
+def main():
+    tpu = _tpu_pairs_per_sec()
+    ref = _numpy_pairs_per_sec()
+    print(
+        json.dumps(
+            {
+                "metric": "pairs/sec/chip",
+                "value": round(tpu, 1),
+                "unit": "pairs/s",
+                "vs_baseline": round(tpu / ref, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Pairwise-SGD learner [SURVEY §1.3, §4.4]: gradient parity with the
+analytic oracle, and end-to-end AUC improvement on both BASELINE-style
+configs (Gaussians + Adult)."""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.data import load_adult, make_gaussians
+from tuplewise_tpu.models.pairwise_sgd import (
+    TrainConfig,
+    split_by_label,
+    evaluate_auc,
+    train_pairwise,
+    train_pairwise_numpy,
+)
+from tuplewise_tpu.models.scorers import LinearScorer, MLPScorer
+
+
+@pytest.fixture(scope="module")
+def gauss():
+    X, Y = make_gaussians(1200, 1200, dim=5, separation=1.2, seed=3)
+    return X, Y
+
+
+class TestGradientParity:
+    def test_one_step_matches_analytic_oracle(self, gauss):
+        """One full-pair SGD step on a 1-chip mesh == the closed-form
+        pairwise gradient step (exact modulo f32)."""
+        Xp, Xn = gauss
+        Xp, Xn = Xp[:300], Xn[:300]
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=1)
+        cfg = TrainConfig(kernel="logistic", lr=0.5, steps=1,
+                          n_workers=1, repartition_every=1, tile=128)
+        p_mesh, _ = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        p_np, _ = train_pairwise_numpy(scorer, dict(p0), Xp, Xn, cfg)
+        np.testing.assert_allclose(p_mesh["w"], p_np["w"], rtol=2e-4, atol=1e-6)
+
+    def test_multi_worker_multi_step_close_to_oracle(self, gauss):
+        """Same schedule, 4 workers, 10 steps: trajectories use different
+        PRNGs for partitioning, so compare final losses loosely."""
+        Xp, Xn = gauss
+        Xp, Xn = Xp[:400], Xn[:400]
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=1)
+        cfg = TrainConfig(kernel="logistic", lr=0.3, steps=10,
+                          n_workers=4, repartition_every=5, tile=128)
+        p_mesh, h_mesh = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        p_np, h_np = train_pairwise_numpy(scorer, dict(p0), Xp, Xn, cfg)
+        assert abs(h_mesh["loss"][-1] - h_np["loss"][-1]) < 0.02
+
+
+class TestEndToEnd:
+    def test_gaussians_auc_improves(self, gauss):
+        Xp, Xn = gauss
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=7)
+        auc0 = evaluate_auc(scorer, p0, Xp, Xn)
+        cfg = TrainConfig(kernel="logistic", lr=0.5, steps=60,
+                          n_workers=8, repartition_every=10, tile=128)
+        p1, hist = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        auc1 = evaluate_auc(scorer, p1, Xp, Xn)
+        assert hist["loss"][-1] < hist["loss"][0]
+        assert auc1 > max(auc0, 0.75)
+
+    def test_sampled_pairs_trains(self, gauss):
+        """B sampled pairs per worker per step (the incomplete-gradient
+        path of SURVEY §4.4) still learns."""
+        Xp, Xn = gauss
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=7)
+        cfg = TrainConfig(kernel="hinge", lr=0.2, steps=80,
+                          n_workers=8, repartition_every=10,
+                          pairs_per_worker=256, tile=128)
+        p1, hist = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        assert evaluate_auc(scorer, p1, Xp, Xn) > 0.75
+
+    def test_adult_config(self):
+        """BASELINE config 2: bipartite ranking on (surrogate) Adult."""
+        X, y, meta = load_adult(n=4000, seed=0)
+        Xp, Xn = split_by_label(X, y)
+        scorer = LinearScorer(dim=X.shape[1])
+        p0 = scorer.init(seed=0)
+        auc0 = evaluate_auc(scorer, p0, Xp, Xn)
+        cfg = TrainConfig(kernel="hinge", lr=0.3, steps=60,
+                          n_workers=8, repartition_every=15, tile=128)
+        p1, _ = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        auc1 = evaluate_auc(scorer, p1, Xp, Xn)
+        # surrogate Adult has deliberate nonlinear structure; a linear
+        # scorer plateaus just under 0.8
+        assert auc1 > max(auc0 + 0.05, 0.78)
+
+    def test_ragged_sizes_train(self, gauss):
+        """Regression: sizes not divisible by N are padded, with a random
+        remainder sitting out each repartition (no fixed-tail exclusion)."""
+        Xp, Xn = gauss
+        Xp, Xn = Xp[:1001], Xn[:997]
+        scorer = LinearScorer(dim=5)
+        cfg = TrainConfig(kernel="logistic", lr=0.3, steps=20,
+                          n_workers=8, repartition_every=5, tile=128)
+        p1, hist = train_pairwise(scorer, scorer.init(0), Xp, Xn, cfg)
+        assert np.isfinite(hist["loss"]).all()
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_mlp_scorer_trains(self, gauss):
+        Xp, Xn = gauss
+        scorer = MLPScorer(dim=5, hidden=16)
+        p0 = scorer.init(seed=2)
+        cfg = TrainConfig(kernel="logistic", lr=0.3, steps=60,
+                          n_workers=8, repartition_every=10, tile=128)
+        p1, _ = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        assert evaluate_auc(scorer, p1, Xp, Xn) > 0.75

@@ -121,6 +121,12 @@ class TestDistributedSchemes:
         se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
         assert abs(np.mean(vals) - u_n) < 5 * se
 
+    def test_small_n_raises_not_nan(self, mesh_est):
+        """Regression: n < n_shards must raise like the oracle backend,
+        not silently return NaN from empty blocks."""
+        with pytest.raises(ValueError, match="too small"):
+            mesh_est.local_average(np.arange(5.0), np.arange(20.0), seed=0)
+
     def test_incomplete_rounds_budget_up(self, scores, mesh_est):
         """n_pairs not divisible by N: at least n_pairs tuples drawn."""
         s1, s2 = scores

@@ -279,17 +279,22 @@ class MeshBackend:
                       scheme="swor"):
         self._check_workers(n_workers)
         A, B = self._two(A, B)
+        self._check_sizes(A, B)
+        Ag = self._global(A)
+        Bg = Ag if B is A else self._global(B)
         key = fold(root_key(seed), "local_average")
         return float(self._local(
-            self._global(A), self._global(B), key,
-            n1=len(A), n2=len(B), scheme=scheme))
+            Ag, Bg, key, n1=len(A), n2=len(B), scheme=scheme))
 
     def repartitioned(self, A, B=None, *, n_workers=None, n_rounds,
                       seed=0, scheme="swor"):
         self._check_workers(n_workers)
         A, B = self._two(A, B)
+        self._check_sizes(A, B)
+        Ag = self._global(A)
+        Bg = Ag if B is A else self._global(B)
         return float(self._repart(
-            self._global(A), self._global(B), root_key(seed),
+            Ag, Bg, root_key(seed),
             n1=len(A), n2=len(B), n_rounds=n_rounds, scheme=scheme))
 
     def incomplete(self, A, B=None, *, n_pairs, seed=0):
@@ -314,6 +319,13 @@ class MeshBackend:
         if self.kernel.two_sample:
             return A, np.asarray(B)
         return A, A
+
+    def _check_sizes(self, A, B):
+        if min(len(A), len(B)) < self.n_shards:
+            raise ValueError(
+                f"n={min(len(A), len(B))} too small for "
+                f"{self.n_shards} workers"
+            )
 
     def _check_workers(self, n_workers):
         if n_workers is not None and n_workers != self.n_shards:

@@ -45,6 +45,9 @@ class Estimator:
             # one worker per chip: size the mesh from n_workers
             backend_opts["n_workers"] = self.n_workers
         self.backend = get_backend(backend, self.kernel, **backend_opts)
+        if hasattr(self.backend, "n_shards"):
+            # mesh backends pin N to the mesh (one worker per chip)
+            self.n_workers = self.backend.n_shards
 
     # ------------------------------------------------------------------ #
     def _resolve_workers(self, n_workers: Optional[int]) -> int:

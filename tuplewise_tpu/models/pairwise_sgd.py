@@ -1,0 +1,276 @@
+"""L5 — distributed pairwise SGD (AUC maximization / bipartite ranking).
+
+The paper's learning path [SURVEY §1.3, §4.4]: minimize the pairwise
+surrogate risk
+
+    L(theta) = mean_{i,j} l( s_theta(x_i) - s_theta(y_j) )
+
+with synchronous distributed SGD: each worker differentiates the loss
+over ITS OWN pairs (all local pairs, or B sampled ones), gradients are
+`lax.pmean`'d over the mesh, parameters update identically everywhere,
+and the data is re-partitioned every ``repartition_every`` steps — the
+communication/repartition trade-off of the title, now on the learning
+side. BASELINE config 2 ("Bipartite ranking / pairwise hinge on Adult").
+
+TPU mapping:
+* full-pair local losses differentiate through the CHECKPOINTED tiled
+  reduction (ops.pair_tiles), so backprop re-streams tiles instead of
+  storing the pair grid [SURVEY §7 "Hard parts"];
+* the whole training run is ONE jitted `lax.scan` over steps; the
+  repartition event is a `lax.cond` regather of worker blocks from the
+  sharded global arrays (XLA's all-to-all — executed only on refresh
+  steps);
+* a NumPy oracle trainer (analytic pairwise gradient, blockwise) pins
+  the semantics for parity tests, mirroring Estimator's backend split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tuplewise_tpu.models.metrics import auc_score
+from tuplewise_tpu.ops import pair_tiles
+from tuplewise_tpu.ops.kernels import get_kernel
+from tuplewise_tpu.parallel.mesh import make_mesh, shard_axis_name as AX
+from tuplewise_tpu.utils.rng import fold, root_key
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Pairwise-SGD hyperparameters [SURVEY §4.4, §5.9]."""
+
+    kernel: str = "logistic"          # surrogate: "logistic" | "hinge"
+    lr: float = 0.1
+    steps: int = 100
+    n_workers: int = 1
+    repartition_every: int = 10       # n_r: communication budget knob
+    pairs_per_worker: Optional[int] = None  # None = all local pairs
+    scheme: str = "swor"
+    seed: int = 0
+    tile: int = 512
+
+
+# --------------------------------------------------------------------- #
+# mesh trainer                                                          #
+# --------------------------------------------------------------------- #
+
+def train_pairwise(
+    scorer,
+    params,
+    X_pos: np.ndarray,
+    X_neg: np.ndarray,
+    cfg: TrainConfig,
+    mesh=None,
+):
+    """Distributed pairwise SGD over a device mesh.
+
+    Returns (params, history) where history["loss"] is the per-step
+    psum-averaged surrogate loss. Runs on any mesh size >= 1 (a 1-chip
+    mesh reproduces serial SGD over the full pair set).
+    """
+    kernel = get_kernel(cfg.kernel)
+    if kernel.kind != "diff":
+        raise ValueError(
+            f"learner needs a score-difference surrogate kernel, got "
+            f"{kernel.name!r} (kind={kernel.kind})"
+        )
+    if kernel.name == "auc":
+        raise ValueError(
+            "the AUC indicator has zero gradient almost everywhere; train "
+            "with a surrogate ('logistic' or 'hinge') and evaluate with "
+            "evaluate_auc"
+        )
+    mesh = mesh if mesh is not None else make_mesh(cfg.n_workers)
+    N = int(np.prod(mesh.devices.shape))
+    shard_blocks = NamedSharding(mesh, P(AX))
+    replicated = NamedSharding(mesh, P())
+
+    n1, n2 = len(X_pos), len(X_neg)
+    m1, m2 = n1 // N, n2 // N
+    if min(m1, m2) < 1:
+        raise ValueError(f"n=({n1},{n2}) too small for {N} workers")
+
+    def _pad_put(X):
+        # zero-pad to a shardable multiple of N; permutations range over
+        # the TRUE n, so each repartition drops a RANDOM remainder (the
+        # padding rows are never gathered)
+        X = np.asarray(X)
+        pad = (-len(X)) % N
+        if pad:
+            X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
+        return jax.device_put(
+            jnp.asarray(X, jnp.float32), NamedSharding(mesh, P(AX, None))
+        )
+
+    Xp, Xn = _pad_put(X_pos), _pad_put(X_neg)
+    params = jax.device_put(
+        jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params),
+        replicated,
+    )
+
+    def draw_blocks(key, n, m):
+        if cfg.scheme == "swor":
+            return (
+                jax.random.permutation(key, n)[: N * m]
+                .reshape(N, m).astype(jnp.int32)
+            )
+        return jax.random.randint(key, (N, m), 0, n, dtype=jnp.int32)
+
+    def sgd_body(params, a, b, key):
+        """One worker's step: local pair gradient, pmean, update.
+        a, b: [1, m, d] local blocks."""
+
+        def loss_fn(p):
+            s1 = scorer.apply(p, a[0], jnp)
+            s2 = scorer.apply(p, b[0], jnp)
+            if cfg.pairs_per_worker is None:
+                return pair_tiles.pair_mean(
+                    kernel, s1, s2, tile_a=cfg.tile, tile_b=cfg.tile
+                )
+            shard = lax.axis_index(AX)
+            kk = fold(key, "pair_sample", shard)
+            i, j = pair_tiles.sample_pair_indices(
+                kk, m1, m2, cfg.pairs_per_worker, one_sample=False
+            )
+            return jnp.mean(kernel.diff(s1[i] - s2[j], jnp))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(lambda g: lax.pmean(g, AX), grads)
+        loss = lax.pmean(loss, AX)
+        new_params = jax.tree.map(
+            lambda p, g: p - cfg.lr * g, params, grads
+        )
+        return new_params, loss
+
+    sgd_smap = jax.shard_map(
+        sgd_body,
+        mesh=mesh,
+        in_specs=(P(), P(AX), P(AX), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    root = root_key(cfg.seed)
+
+    def step_fn(carry, t):
+        params, Ab, Bb = carry
+        kt = fold(root, "step", t)
+
+        def refresh(_):
+            kr = fold(root, "repartition", t)
+            k1, k2 = jax.random.split(kr)
+            i1 = draw_blocks(k1, n1, m1)
+            i2 = draw_blocks(k2, n2, m2)
+            return (
+                Xp.at[i1].get(out_sharding=shard_blocks),
+                Xn.at[i2].get(out_sharding=shard_blocks),
+            )
+
+        # t=0's blocks are drawn outside the scan with the same key, so
+        # only refresh on later repartition boundaries (one startup
+        # regather, not two)
+        Ab, Bb = lax.cond(
+            (t % cfg.repartition_every == 0) & (t > 0),
+            refresh, lambda _: (Ab, Bb), None,
+        )
+        params, loss = sgd_smap(params, Ab, Bb, kt)
+        return (params, Ab, Bb), loss
+
+    @jax.jit
+    def run(params):
+        k0 = fold(root, "repartition", 0)
+        k1, k2 = jax.random.split(k0)
+        Ab = Xp.at[draw_blocks(k1, n1, m1)].get(out_sharding=shard_blocks)
+        Bb = Xn.at[draw_blocks(k2, n2, m2)].get(out_sharding=shard_blocks)
+        (params, _, _), losses = lax.scan(
+            step_fn, (params, Ab, Bb), jnp.arange(cfg.steps)
+        )
+        return params, losses
+
+    params, losses = run(params)
+    return (
+        jax.tree.map(np.asarray, params),
+        {"loss": np.asarray(losses)},
+    )
+
+
+# --------------------------------------------------------------------- #
+# NumPy oracle trainer (parity reference)                               #
+# --------------------------------------------------------------------- #
+
+_SURROGATE_DERIV = {
+    # d/dd of the surrogate l(d)
+    "logistic": lambda d: -1.0 / (1.0 + np.exp(d)),   # -sigmoid(-d)
+    "hinge": lambda d: np.where(d < 1.0, -1.0, 0.0),
+}
+
+
+def train_pairwise_numpy(
+    scorer,
+    params,
+    X_pos: np.ndarray,
+    X_neg: np.ndarray,
+    cfg: TrainConfig,
+):
+    """Serial oracle: same schedule, analytic full-pair gradients for a
+    LINEAR scorer (the paper's model), blockwise over the pair grid."""
+    assert cfg.kernel in _SURROGATE_DERIV, cfg.kernel
+    assert cfg.pairs_per_worker is None, "oracle trainer uses all pairs"
+    deriv = _SURROGATE_DERIV[cfg.kernel]
+    kernel = get_kernel(cfg.kernel)
+    from tuplewise_tpu.parallel.partition import partition_two_sample
+
+    params = {k: np.asarray(v, np.float64) for k, v in params.items()}
+    rng = np.random.default_rng(cfg.seed)
+    N = cfg.n_workers
+    losses = []
+    parts = partition_two_sample(len(X_pos), len(X_neg), N, rng, cfg.scheme)
+    for t in range(cfg.steps):
+        if t % cfg.repartition_every == 0:
+            parts = partition_two_sample(
+                len(X_pos), len(X_neg), N, rng, cfg.scheme
+            )
+        g_w = np.zeros_like(params["w"])
+        g_b = 0.0  # pairwise loss of s(x)-s(y) has zero bias gradient
+        loss_acc = 0.0
+        for w_idx in range(N):
+            A = X_pos[parts[0][w_idx]]
+            Bm = X_neg[parts[1][w_idx]]
+            s1 = A @ params["w"] + params["b"]
+            s2 = Bm @ params["w"] + params["b"]
+            d = s1[:, None] - s2[None, :]
+            lp = deriv(d)
+            cnt = d.size
+            loss_acc += float(np.mean(kernel.diff(d, np)))
+            # dL/dw = mean_ij l'(d_ij) (x_i - y_j)
+            g_w += (lp.sum(axis=1) @ A + (-lp.sum(axis=0)) @ Bm) / cnt
+        params["w"] = params["w"] - cfg.lr * (g_w / N)
+        params["b"] = params["b"] - cfg.lr * g_b
+        losses.append(loss_acc / N)
+    return params, {"loss": np.asarray(losses)}
+
+
+# --------------------------------------------------------------------- #
+# helpers                                                               #
+# --------------------------------------------------------------------- #
+
+def split_by_label(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(positives, negatives) feature blocks from a labeled set."""
+    y = np.asarray(y)
+    return np.asarray(X)[y == 1], np.asarray(X)[y == 0]
+
+
+def evaluate_auc(scorer, params, X_pos, X_neg) -> float:
+    """Rank-based test AUC of the scorer [SURVEY §3 'Evaluation']."""
+    params = jax.tree.map(np.asarray, params)
+    s1 = scorer.apply(params, np.asarray(X_pos), np)
+    s2 = scorer.apply(params, np.asarray(X_neg), np)
+    return auc_score(s1, s2)

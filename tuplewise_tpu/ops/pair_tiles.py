@@ -121,6 +121,13 @@ def pair_stats(
         )
     use_ids = ids_a is not None
     dtype = A.dtype
+    # Fast path: no masks/ids and no padding needed -> the weight grid is
+    # all-ones. Skipping the mask multiply + count reduction saves ~1/3
+    # of the per-pair VPU work in the common complete-U case.
+    unweighted = (
+        mask_a is None and mask_b is None and not use_ids
+        and A.shape[0] % tile_a == 0 and B.shape[0] % tile_b == 0
+    )
     ma = jnp.ones(A.shape[0], dtype) if mask_a is None else mask_a
     mb = jnp.ones(B.shape[0], dtype) if mask_b is None else mask_b
 
@@ -136,6 +143,11 @@ def pair_stats(
     @jax.checkpoint
     def tile_term(a, ma_, ia, b, mb_, ib):
         vals = kernel.pair_matrix(a, b, jnp)
+        if unweighted:
+            return (
+                jnp.sum(vals, dtype=dtype),
+                jnp.asarray(tile_a * tile_b, jnp.int32),
+            )
         w = ma_[:, None] * mb_[None, :]
         if use_ids:
             w = w * (ia[:, None] != ib[None, :]).astype(dtype)

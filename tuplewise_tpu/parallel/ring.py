@@ -54,6 +54,11 @@ def ring_pair_stats(
     single-device pair_stats over the concatenated data — the ring
     invariance property tested in tests/test_mesh_backend.py.
     """
+    if (ids_a is None) != (ids_b is None):
+        raise ValueError(
+            "ring_pair_stats needs BOTH ids_a and ids_b (or neither); "
+            "a lone ids side would silently mis-exclude pairs"
+        )
     n_shards = lax.axis_size(axis_name)
     dtype = a.dtype
     mb = jnp.ones(b.shape[0], dtype) if mask_b is None else mask_b
@@ -99,12 +104,21 @@ def ring_triplet_stats(
 
     Anchors stay resident; O(N^2) ppermutes of small blocks ride the ICI
     ring while each step runs the O(m^3) tile reduction.
+
+    ids_x is REQUIRED: anchor/positive exclusion must use GLOBAL row ids
+    — a per-shard local arange would spuriously exclude cross-shard
+    (anchor, positive) combinations that share a local offset.
     """
+    if ids_x is None:
+        raise ValueError(
+            "ring_triplet_stats requires global ids_x; per-shard local "
+            "indices would mis-exclude cross-shard anchor/positive pairs"
+        )
     n_shards = lax.axis_size(axis_name)
     dtype = x.dtype
     mx = jnp.ones(x.shape[0], dtype) if mask_x is None else mask_x
     my = jnp.ones(y.shape[0], dtype) if mask_y is None else mask_y
-    ix = (jnp.arange(x.shape[0]) if ids_x is None else ids_x).astype(jnp.int32)
+    ix = ids_x.astype(jnp.int32)
     perm = _ring_perm(axis_name)
 
     # anchors: resident block (x, mx, ix); positives: visiting (p); negatives: visiting (ynext)
