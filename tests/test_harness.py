@@ -1,0 +1,145 @@
+"""L4/L6 harness: vmapped Monte-Carlo correctness, trade-off shapes,
+CLI, figures, triplet experiment."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.data import make_gaussians, true_gaussian_auc
+from tuplewise_tpu.harness import (
+    VarianceConfig,
+    run_variance_experiment,
+    tradeoff_vs_pairs,
+    tradeoff_vs_rounds,
+    triplet_mnist_statistic,
+)
+
+
+BASE = VarianceConfig(n_pos=512, n_neg=512, n_workers=8, n_reps=64)
+
+
+class TestVarianceExperiment:
+    def test_complete_vmapped_matches_population(self):
+        r = run_variance_experiment(BASE)
+        assert r["vmapped"]
+        assert abs(r["mean"] - true_gaussian_auc(1.0)) < 5 * r["std_error"] + 1e-3
+
+    def test_complete_variance_matches_hoeffding(self):
+        """Empirical MC variance ~ closed-form Hoeffding variance
+        [SURVEY §5.1 'Statistical tests'] — the harness's own oracle."""
+        cfg = VarianceConfig(n_pos=256, n_neg=256, n_reps=400)
+        r = run_variance_experiment(cfg)
+        # variance formula at n=256 via zetas from a large plug-in sample
+        from tuplewise_tpu.estimators.variance import (
+            two_sample_variance_from_zetas,
+            two_sample_zetas,
+        )
+        X, Y = make_gaussians(20_000, 20_000, 1, 1.0, seed=123)
+        z = two_sample_zetas("auc", X[:, 0], Y[:, 0])
+        pred = two_sample_variance_from_zetas(z, 256, 256)
+        assert abs(r["variance"] - pred) / pred < 0.35
+
+    def test_schemes_ordering(self):
+        """Var(complete) <= Var(repartitioned T=4) <= Var(local)
+        [SURVEY §1.2] on conditional-free MC over fresh draws."""
+        out = {}
+        for scheme, kw in [
+            ("complete", {}),
+            ("repartitioned", {"n_rounds": 4}),
+            ("local", {}),
+        ]:
+            cfg = VarianceConfig(
+                n_pos=128, n_neg=128, n_workers=8, n_reps=300,
+                scheme=scheme, **kw,
+            )
+            out[scheme] = run_variance_experiment(cfg)["variance"]
+        assert out["complete"] <= out["repartitioned"] * 1.2
+        assert out["repartitioned"] < out["local"] * 1.2
+
+    def test_incomplete_variance_formula(self):
+        cfg = VarianceConfig(
+            n_pos=512, n_neg=512, scheme="incomplete", n_pairs=500,
+            n_reps=400,
+        )
+        r = run_variance_experiment(cfg)
+        X, Y = make_gaussians(40_000, 40_000, 1, 1.0, seed=77)
+        # incomplete-variance formula at n=512 via large-sample zetas
+        from tuplewise_tpu.estimators.variance import (
+            two_sample_variance_from_zetas,
+            two_sample_zetas,
+        )
+        z = two_sample_zetas("auc", X[:, 0], Y[:, 0])
+        pred = two_sample_variance_from_zetas(z, 512, 512) + (
+            z[2] - two_sample_variance_from_zetas(z, 512, 512)
+        ) / 500
+        assert abs(r["variance"] - pred) / pred < 0.35
+
+    def test_numpy_backend_loop_path(self):
+        cfg = VarianceConfig(
+            backend="numpy", n_pos=128, n_neg=128, n_reps=20,
+        )
+        r = run_variance_experiment(cfg)
+        assert not r["vmapped"]
+        assert abs(r["mean"] - true_gaussian_auc(1.0)) < 0.05
+
+
+class TestTradeoffs:
+    def test_variance_decreases_with_rounds(self):
+        cfg = VarianceConfig(n_pos=128, n_neg=128, n_workers=8, n_reps=200)
+        rs = tradeoff_vs_rounds(cfg, rounds=(1, 8))
+        assert rs[1]["variance"] < rs[0]["variance"]
+
+    def test_variance_decreases_with_pairs(self):
+        cfg = VarianceConfig(n_pos=512, n_neg=512, n_reps=150)
+        rs = tradeoff_vs_pairs(cfg, pairs=(100, 10_000))
+        assert rs[1]["variance"] < rs[0]["variance"]
+
+
+class TestTriplet:
+    def test_mnist_triplet_statistic(self):
+        r = triplet_mnist_statistic(n=400, n_pairs=2000, backend="jax")
+        assert 0.9 < r["mean"] <= 1.0  # well-separated surrogate classes
+        assert len(r["per_class"]) == 10
+
+    def test_complete_small(self):
+        r = triplet_mnist_statistic(n=150, n_pairs=None, backend="numpy")
+        assert 0.9 < r["mean"] <= 1.0
+
+
+class TestCLIAndFigures:
+    def test_cli_variance_json(self, tmp_path):
+        out = tmp_path / "r.jsonl"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tuplewise_tpu.harness.cli", "variance",
+             "--n-pos", "128", "--n-neg", "128", "--n-reps", "10",
+             "--backend", "numpy", "--out", str(out)],
+            capture_output=True, text=True,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                 "PYTHONPATH": "/root/repo"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        r = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert 0.5 < r["mean"] < 1.0
+        assert out.exists()
+
+    def test_figures(self, tmp_path):
+        from tuplewise_tpu.harness.figures import (
+            plot_variance_vs_pairs,
+            plot_variance_vs_rounds,
+            plot_variance_vs_wallclock,
+        )
+
+        cfg = VarianceConfig(n_pos=128, n_neg=128, n_reps=30)
+        rs = tradeoff_vs_rounds(cfg, rounds=(1, 4))
+        base = run_variance_experiment(cfg)
+        p1 = plot_variance_vs_rounds(rs, str(tmp_path / "t.png"), base)
+        p2 = plot_variance_vs_wallclock(rs, str(tmp_path / "w.png"))
+        ps = tradeoff_vs_pairs(cfg, pairs=(100, 1000))
+        p3 = plot_variance_vs_pairs(ps, str(tmp_path / "b.png"))
+        import os
+
+        for p in (p1, p2, p3):
+            assert os.path.getsize(p) > 1000

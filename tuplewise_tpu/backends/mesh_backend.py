@@ -105,12 +105,10 @@ class MeshBackend:
         self._complete = complete_fn
 
         # ---- local average / repartitioned ---------------------------- #
+        from tuplewise_tpu.parallel.device_partition import draw_blocks as _draw
+
         def draw_blocks(key, n, scheme):
-            m = n // N
-            if scheme == "swor":
-                idx = jax.random.permutation(key, n)[: N * m]
-                return idx.reshape(N, m).astype(jnp.int32)
-            return jax.random.randint(key, (N, m), 0, n, dtype=jnp.int32)
+            return _draw(key, n, N, scheme)
 
         def local_mean_body(a, ia, b, ib):
             """Per-shard complete U on its local block; [1, m] blocks."""
@@ -249,20 +247,11 @@ class MeshBackend:
         )
 
     def _global(self, X):
-        """1-D sharded global array, zero-PADDED to a multiple of N.
+        """Zero-padded worker-sharded global array (see
+        parallel.device_partition.pad_put for the padding rationale)."""
+        from tuplewise_tpu.parallel.device_partition import pad_put
 
-        Padding (never truncation) keeps every real row reachable: the
-        on-device permutations range over the true n, so which remainder
-        rows sit out a round is random per seed, not a fixed tail."""
-        X = np.asarray(X)
-        pad = (-len(X)) % self.n_shards
-        if pad:
-            X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
-        return jax.device_put(
-            jnp.asarray(X, self.dtype),
-            NamedSharding(self.mesh, P(AX)) if X.ndim == 1
-            else NamedSharding(self.mesh, P(AX, *([None] * (X.ndim - 1)))),
-        )
+        return pad_put(X, self.mesh, self.dtype)
 
     # ------------------------------------------------------------------ #
     # estimator schemes                                                  #

@@ -36,18 +36,26 @@ class Estimator:
     """
 
     def __init__(self, kernel="auc", backend: str = "numpy",
-                 n_workers: int = 1, **backend_opts):
+                 n_workers: Optional[int] = None, **backend_opts):
         self.kernel = get_kernel(kernel)
-        self.n_workers = int(n_workers)
         self.backend_name = backend
         if (backend == "mesh" and "mesh" not in backend_opts
-                and "n_workers" not in backend_opts):
+                and "n_workers" not in backend_opts and n_workers is not None):
             # one worker per chip: size the mesh from n_workers
-            backend_opts["n_workers"] = self.n_workers
+            backend_opts["n_workers"] = n_workers
         self.backend = get_backend(backend, self.kernel, **backend_opts)
         if hasattr(self.backend, "n_shards"):
-            # mesh backends pin N to the mesh (one worker per chip)
+            # mesh backends pin N to the mesh (one worker per chip); an
+            # explicitly requested different N is a config error, not
+            # something to silently override
+            if n_workers is not None and n_workers != self.backend.n_shards:
+                raise ValueError(
+                    f"n_workers={n_workers} conflicts with the mesh's "
+                    f"{self.backend.n_shards} shards (one worker per chip)"
+                )
             self.n_workers = self.backend.n_shards
+        else:
+            self.n_workers = 1 if n_workers is None else int(n_workers)
 
     # ------------------------------------------------------------------ #
     def _resolve_workers(self, n_workers: Optional[int]) -> int:

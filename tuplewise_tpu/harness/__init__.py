@@ -1,0 +1,15 @@
+from tuplewise_tpu.harness.variance import (
+    VarianceConfig,
+    run_variance_experiment,
+    tradeoff_vs_rounds,
+    tradeoff_vs_pairs,
+)
+from tuplewise_tpu.harness.triplet_experiment import triplet_mnist_statistic
+
+__all__ = [
+    "VarianceConfig",
+    "run_variance_experiment",
+    "tradeoff_vs_rounds",
+    "tradeoff_vs_pairs",
+    "triplet_mnist_statistic",
+]

@@ -1,0 +1,155 @@
+"""L6 — experiment CLI reproducing the paper-style figures/tables.
+
+    python -m tuplewise_tpu.harness.cli variance --scheme repartitioned --n-rounds 4
+    python -m tuplewise_tpu.harness.cli tradeoff-rounds --n-reps 200 --out results.jsonl
+    python -m tuplewise_tpu.harness.cli tradeoff-pairs
+    python -m tuplewise_tpu.harness.cli triplet --n 2000
+    python -m tuplewise_tpu.harness.cli train --dataset adult --steps 100
+
+Each command prints JSON to stdout and can append JSONL via --out
+[SURVEY §2 L6, §5.6].
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from tuplewise_tpu.harness.variance import (
+    VarianceConfig,
+    run_variance_experiment,
+    tradeoff_vs_pairs,
+    tradeoff_vs_rounds,
+    write_jsonl,
+)
+
+
+def _add_variance_args(p: argparse.ArgumentParser) -> None:
+    for f in dataclasses.fields(VarianceConfig):
+        flag = "--" + f.name.replace("_", "-")
+        if f.type is int or f.type == "int":
+            p.add_argument(flag, type=int, default=f.default)
+        elif f.type is float or f.type == "float":
+            p.add_argument(flag, type=float, default=f.default)
+        else:
+            p.add_argument(flag, type=str, default=f.default)
+
+
+def _cfg_from_args(args) -> VarianceConfig:
+    names = {f.name for f in dataclasses.fields(VarianceConfig)}
+    return VarianceConfig(
+        **{k: v for k, v in vars(args).items() if k in names}
+    )
+
+
+def _emit(results, out):
+    if isinstance(results, dict):
+        results = [results]
+    for r in results:
+        print(json.dumps(r))
+    if out:
+        write_jsonl(results, out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tuplewise-harness")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    for name in ("variance", "tradeoff-rounds", "tradeoff-pairs"):
+        p = sub.add_parser(name)
+        _add_variance_args(p)
+        p.add_argument("--out", type=str, default=None)
+        if name == "tradeoff-rounds":
+            p.add_argument("--rounds", type=int, nargs="+",
+                           default=[1, 2, 4, 8, 16])
+        if name == "tradeoff-pairs":
+            p.add_argument("--pairs", type=int, nargs="+",
+                           default=[100, 1000, 10_000, 100_000])
+
+    p = sub.add_parser("triplet")
+    p.add_argument("--kernel", default="triplet_indicator")
+    p.add_argument("--backend", default="jax")
+    p.add_argument("--n", type=int, default=2000)
+    p.add_argument("--n-pairs", type=int, default=20_000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", type=str, default=None)
+
+    p = sub.add_parser("train")
+    p.add_argument("--dataset", choices=["gaussians", "adult"],
+                   default="adult")
+    p.add_argument("--kernel", default="hinge")
+    p.add_argument("--lr", type=float, default=0.3)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--n-workers", type=int, default=1)
+    p.add_argument("--repartition-every", type=int, default=10)
+    p.add_argument("--pairs-per-worker", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--n", type=int, default=8000)
+    p.add_argument("--out", type=str, default=None)
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "variance":
+        _emit(run_variance_experiment(_cfg_from_args(args)), args.out)
+    elif args.cmd == "tradeoff-rounds":
+        _emit(tradeoff_vs_rounds(_cfg_from_args(args), args.rounds), args.out)
+    elif args.cmd == "tradeoff-pairs":
+        _emit(tradeoff_vs_pairs(_cfg_from_args(args), args.pairs), args.out)
+    elif args.cmd == "triplet":
+        from tuplewise_tpu.harness.triplet_experiment import (
+            triplet_mnist_statistic,
+        )
+
+        _emit(
+            triplet_mnist_statistic(
+                kernel=args.kernel, backend=args.backend, n=args.n,
+                n_pairs=args.n_pairs, seed=args.seed,
+            ),
+            args.out,
+        )
+    elif args.cmd == "train":
+        import numpy as np
+
+        from tuplewise_tpu.data import load_adult, make_gaussians
+        from tuplewise_tpu.models.pairwise_sgd import (
+            TrainConfig, evaluate_auc, split_by_label, train_pairwise,
+        )
+        from tuplewise_tpu.models.scorers import LinearScorer
+
+        if args.dataset == "adult":
+            X, y, meta = load_adult(n=args.n, seed=args.seed)
+            Xp, Xn = split_by_label(X, y)
+        else:
+            Xp, Xn = make_gaussians(
+                args.n // 2, args.n // 2, dim=5, separation=1.0,
+                seed=args.seed,
+            )
+            meta = {"synthetic": True, "source": "gaussians"}
+        scorer = LinearScorer(dim=Xp.shape[1])
+        p0 = scorer.init(args.seed)
+        cfg = TrainConfig(
+            kernel=args.kernel, lr=args.lr, steps=args.steps,
+            n_workers=args.n_workers,
+            repartition_every=args.repartition_every,
+            pairs_per_worker=args.pairs_per_worker, seed=args.seed,
+        )
+        params, hist = train_pairwise(scorer, p0, Xp, Xn, cfg)
+        _emit(
+            {
+                "config": dataclasses.asdict(cfg),
+                "dataset": args.dataset,
+                "data_meta": meta,
+                "auc_before": evaluate_auc(scorer, p0, Xp, Xn),
+                "auc_after": evaluate_auc(scorer, params, Xp, Xn),
+                "loss_first": float(hist["loss"][0]),
+                "loss_last": float(hist["loss"][-1]),
+            },
+            args.out,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""Figure generation: the paper-shaped trade-off plots [SURVEY §2 L4/L6].
+
+Kept separate from measurement (harness emits JSONL; figures consume it
+or fresh results) per SURVEY §5.6. Matplotlib only; written to PNG.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+
+def _results(path_or_list):
+    if isinstance(path_or_list, (list, tuple)):
+        return list(path_or_list)
+    with open(path_or_list) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def plot_variance_vs_rounds(results, out_png: str,
+                            baseline: Optional[dict] = None) -> str:
+    """Variance vs T (repartitions) — the communication trade-off curve
+    [SURVEY §1.2 item 3]; optionally overlays the complete-U variance."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rs = _results(results)
+    T = [r["config"]["n_rounds"] for r in rs]
+    var = [r["variance"] for r in rs]
+    fig, ax = plt.subplots(figsize=(5, 3.5))
+    ax.loglog(T, var, "o-", label="repartitioned $U_{N,T}$")
+    if baseline is not None:
+        ax.axhline(baseline["variance"], ls="--", c="gray",
+                   label="complete $U_n$")
+    ax.set_xlabel("repartition rounds T (communication)")
+    ax.set_ylabel("estimator variance")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png
+
+
+def plot_variance_vs_wallclock(results, out_png: str) -> str:
+    """Variance vs wall-clock — the headline trade-off axis
+    (BASELINE.json:2)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rs = _results(results)
+    wc = [r["wallclock_s"] / r["n_reps"] for r in rs]
+    var = [r["variance"] for r in rs]
+    labels = [str(r["config"].get("n_rounds", "")) for r in rs]
+    fig, ax = plt.subplots(figsize=(5, 3.5))
+    ax.loglog(wc, var, "o-")
+    for x, y, l in zip(wc, var, labels):
+        ax.annotate(f"T={l}", (x, y), fontsize=7,
+                    textcoords="offset points", xytext=(4, 4))
+    ax.set_xlabel("wall-clock per estimate [s]")
+    ax.set_ylabel("estimator variance")
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png
+
+
+def plot_variance_vs_pairs(results, out_png: str) -> str:
+    """Variance vs sampled-pair budget B (incomplete U) [SURVEY §1.1]."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rs = _results(results)
+    B = [r["config"]["n_pairs"] for r in rs]
+    var = [r["variance"] for r in rs]
+    fig, ax = plt.subplots(figsize=(5, 3.5))
+    ax.loglog(B, var, "o-", label=r"incomplete $\tilde{U}_B$")
+    ax.set_xlabel("sampled pairs B")
+    ax.set_ylabel("estimator variance")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png

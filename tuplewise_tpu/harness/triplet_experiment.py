@@ -1,0 +1,62 @@
+"""BASELINE config 4: degree-3 triplet metric-learning statistics on
+MNIST embeddings [SURVEY §1.1 "Degree-3", §3 "Triplet kernel"].
+
+For each class c, the degree-(2,1) triplet U-statistic takes (anchor,
+positive) pairs from class c and negatives from the other classes:
+
+    U_c = mean_{i != j in c, k not in c} h(x_i, x_j, y_k)
+
+and the reported statistic averages U_c over classes — with the
+indicator kernel this is the class-balanced triplet accuracy of the
+embedding (the fraction of relative-similarity constraints satisfied).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from tuplewise_tpu.data import load_mnist_embeddings
+from tuplewise_tpu.estimators.estimator import Estimator
+
+
+def triplet_mnist_statistic(
+    kernel: str = "triplet_indicator",
+    backend: str = "jax",
+    n: int = 2000,
+    n_pairs: Optional[int] = 20_000,
+    classes: Optional[list] = None,
+    seed: int = 0,
+    path: Optional[str] = None,
+    **backend_opts,
+) -> dict:
+    """Per-class triplet U-statistics over MNIST embeddings.
+
+    n_pairs None -> complete statistic (O(n_c^2 * n) — small n only);
+    otherwise the incomplete estimator with B=n_pairs sampled triplets.
+    """
+    E, labels, meta = load_mnist_embeddings(path=path, n=n, seed=seed)
+    est = Estimator(kernel, backend=backend, **backend_opts)
+    per_class = {}
+    for c in sorted(set(classes or np.unique(labels).tolist())):
+        Xc = E[labels == c]
+        Yc = E[labels != c]
+        if len(Xc) < 2 or len(Yc) < 1:
+            continue
+        if n_pairs is None:
+            per_class[int(c)] = est.complete(Xc, Yc)
+        else:
+            per_class[int(c)] = est.incomplete(
+                Xc, Yc, n_pairs=n_pairs, seed=seed
+            )
+    values = list(per_class.values())
+    return {
+        "per_class": per_class,
+        "mean": float(np.mean(values)),
+        "kernel": kernel,
+        "backend": backend,
+        "n": n,
+        "n_pairs": n_pairs,
+        "data_meta": meta,
+    }

@@ -1,0 +1,214 @@
+"""L4 — Monte-Carlo variance harness and trade-off curves.
+
+The experiment that produces the paper's figures [SURVEY §4.5]: repeat an
+estimator M times over fresh data draws (and fresh partitions), report
+empirical mean/variance and wall-clock, and sweep the communication
+knobs — T repartition rounds, B sampled pairs — to trace the
+variance-vs-communication trade-off [SURVEY §1.2, §6].
+
+Monte-Carlo reps are VMAPPED on device for the synthetic-Gaussian score
+experiments (the paper's core setting), not python-looped
+[SURVEY §7 "Hard parts"]: data generation (jax.random, folded per-rep
+keys), estimation, and the M-rep reduction compile into one XLA program.
+Feature-kernel / real-data configs fall back to looping the public
+Estimator API, so every backend/kernel combination is measurable.
+
+Results serialize to JSONL with their full config [SURVEY §5.6, §5.9].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+from tuplewise_tpu.data import make_gaussians, true_gaussian_auc
+from tuplewise_tpu.estimators.estimator import Estimator
+from tuplewise_tpu.ops.kernels import get_kernel
+
+
+@dataclasses.dataclass(frozen=True)
+class VarianceConfig:
+    """One variance experiment [SURVEY §5.9: single dataclass + CLI]."""
+
+    kernel: str = "auc"
+    scheme: str = "complete"          # complete | local | repartitioned | incomplete
+    backend: str = "jax"
+    n_pos: int = 10_000
+    n_neg: int = 10_000
+    dim: int = 1
+    separation: float = 1.0
+    n_workers: int = 8
+    n_rounds: int = 1                 # T (repartitioned)
+    n_pairs: int = 10_000             # B (incomplete)
+    partition_scheme: str = "swor"
+    n_reps: int = 100                 # M Monte-Carlo repetitions
+    seed: int = 0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _estimate_once(est: Estimator, cfg: VarianceConfig, rep: int) -> float:
+    X, Y = make_gaussians(
+        cfg.n_pos, cfg.n_neg, cfg.dim, cfg.separation,
+        seed=cfg.seed * 1_000_003 + rep,
+    )
+    s1, s2 = X[:, 0], Y[:, 0]
+    if cfg.scheme == "complete":
+        return est.complete(s1, s2)
+    if cfg.scheme == "local":
+        return est.local_average(
+            s1, s2, seed=rep, scheme=cfg.partition_scheme
+        )
+    if cfg.scheme == "repartitioned":
+        return est.repartitioned(
+            s1, s2, n_rounds=cfg.n_rounds, seed=rep,
+            scheme=cfg.partition_scheme,
+        )
+    if cfg.scheme == "incomplete":
+        return est.incomplete(s1, s2, n_pairs=cfg.n_pairs, seed=rep)
+    raise ValueError(f"unknown scheme {cfg.scheme!r}")
+
+
+def _vmapped_jax_experiment(cfg: VarianceConfig) -> Optional[np.ndarray]:
+    """One-XLA-program Monte-Carlo for diff kernels on Gaussian scores.
+
+    Returns (estimates, compute_wallclock_s) — compiled in a warm-up call
+    so the wallclock is pure compute — or None if this config isn't
+    vmappable (feature kernels, non-jax backends, mesh execution).
+    """
+    if cfg.backend != "jax" or get_kernel(cfg.kernel).kind != "diff":
+        return None
+
+    import jax
+    import jax.numpy as jnp
+
+    from tuplewise_tpu.ops import pair_tiles
+    from tuplewise_tpu.utils.rng import fold, root_key
+
+    kernel = get_kernel(cfg.kernel)
+    n1, n2, N = cfg.n_pos, cfg.n_neg, cfg.n_workers
+    tile = 512 if max(n1, n2) >= 512 else 128
+
+    def gen(key):
+        k1, k2 = jax.random.split(key)
+        s1 = jax.random.normal(k1, (n1,), jnp.float32) + cfg.separation
+        s2 = jax.random.normal(k2, (n2,), jnp.float32)
+        return s1, s2
+
+    from tuplewise_tpu.parallel.device_partition import draw_blocks
+
+    def local_round(s1, s2, key):
+        m1, m2 = n1 // N, n2 // N
+        k1, k2 = jax.random.split(key)
+        b1 = s1[draw_blocks(k1, n1, N, cfg.partition_scheme)]
+        b2 = s2[draw_blocks(k2, n2, N, cfg.partition_scheme)]
+
+        def worker(a, b):
+            s, c = pair_tiles.pair_stats(
+                kernel, a, b, tile_a=min(tile, m1), tile_b=min(tile, m2)
+            )
+            return s / c
+
+        return jnp.mean(jax.vmap(worker)(b1, b2))
+
+    def one_rep(rep):
+        key = fold(root_key(cfg.seed), "mc_rep", rep)
+        s1, s2 = gen(fold(key, "data"))
+        if cfg.scheme == "complete":
+            s, c = pair_tiles.pair_stats(
+                kernel, s1, s2, tile_a=tile, tile_b=tile
+            )
+            return s / c
+        if cfg.scheme == "local":
+            return local_round(s1, s2, fold(key, "partition"))
+        if cfg.scheme == "repartitioned":
+            rounds = jax.vmap(
+                lambda t: local_round(s1, s2, fold(key, "partition", t))
+            )(jnp.arange(cfg.n_rounds))
+            return jnp.mean(rounds)
+        if cfg.scheme == "incomplete":
+            return pair_tiles.incomplete_pair_mean(
+                kernel, fold(key, "pairs"), s1, s2, cfg.n_pairs, False
+            )
+        raise ValueError(cfg.scheme)
+
+    run = jax.jit(jax.vmap(one_rep))
+    reps = jnp.arange(cfg.n_reps)
+    np.asarray(run(reps))  # warm-up: compile outside the timing window
+    t0 = time.perf_counter()
+    estimates = np.asarray(run(reps))  # forced to host = synced
+    return estimates, time.perf_counter() - t0
+
+
+_SCHEMES = ("complete", "local", "repartitioned", "incomplete")
+
+
+def run_variance_experiment(cfg: VarianceConfig) -> dict:
+    """M-rep Monte-Carlo [SURVEY §4.5]. Returns a JSON-serializable dict
+    with mean, empirical variance, wall-clock, and the config."""
+    if cfg.scheme not in _SCHEMES:
+        raise ValueError(
+            f"unknown scheme {cfg.scheme!r}; choose one of {_SCHEMES}"
+        )
+    vmapped_out = _vmapped_jax_experiment(cfg)
+    vmapped = vmapped_out is not None
+    if vmapped:
+        # compile happened in a warm-up call: wallclock is compute only,
+        # which is what the variance-vs-wallclock trade-off figure needs
+        estimates, wallclock = vmapped_out
+    else:
+        est = Estimator(
+            cfg.kernel, backend=cfg.backend, n_workers=cfg.n_workers
+        )
+        t0 = time.perf_counter()
+        estimates = np.asarray(
+            [_estimate_once(est, cfg, m) for m in range(cfg.n_reps)]
+        )
+        wallclock = time.perf_counter() - t0
+    result = {
+        "config": cfg.to_json(),
+        "mean": float(np.mean(estimates)),
+        "variance": float(np.var(estimates, ddof=1)),
+        "std_error": float(np.std(estimates, ddof=1) / np.sqrt(cfg.n_reps)),
+        "wallclock_s": wallclock,
+        "vmapped": vmapped,
+        "n_reps": cfg.n_reps,
+    }
+    if cfg.kernel == "auc" and cfg.dim == 1:
+        result["population_value"] = true_gaussian_auc(cfg.separation)
+    return result
+
+
+# --------------------------------------------------------------------- #
+# trade-off curves [SURVEY §1.2: THE trade-off in the title]            #
+# --------------------------------------------------------------------- #
+
+def tradeoff_vs_rounds(cfg: VarianceConfig, rounds=(1, 2, 4, 8, 16)):
+    """Variance (and wall-clock) vs number of repartitions T: the
+    communication-buys-variance curve [SURVEY §1.2 item 3]."""
+    out = []
+    for T in rounds:
+        c = dataclasses.replace(cfg, scheme="repartitioned", n_rounds=T)
+        out.append(run_variance_experiment(c))
+    return out
+
+
+def tradeoff_vs_pairs(cfg: VarianceConfig, pairs=(100, 1000, 10_000, 100_000)):
+    """Variance vs sampled-pair budget B [SURVEY §1.1 incomplete]."""
+    out = []
+    for B in pairs:
+        c = dataclasses.replace(cfg, scheme="incomplete", n_pairs=B)
+        out.append(run_variance_experiment(c))
+    return out
+
+
+def write_jsonl(results, path: str) -> None:
+    """Append results (list of dicts) as JSON lines [SURVEY §5.6]."""
+    with open(path, "a") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")

@@ -93,36 +93,22 @@ def train_pairwise(
     shard_blocks = NamedSharding(mesh, P(AX))
     replicated = NamedSharding(mesh, P())
 
+    from tuplewise_tpu.parallel.device_partition import draw_blocks as _draw
+    from tuplewise_tpu.parallel.device_partition import pad_put
+
     n1, n2 = len(X_pos), len(X_neg)
     m1, m2 = n1 // N, n2 // N
     if min(m1, m2) < 1:
         raise ValueError(f"n=({n1},{n2}) too small for {N} workers")
 
-    def _pad_put(X):
-        # zero-pad to a shardable multiple of N; permutations range over
-        # the TRUE n, so each repartition drops a RANDOM remainder (the
-        # padding rows are never gathered)
-        X = np.asarray(X)
-        pad = (-len(X)) % N
-        if pad:
-            X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
-        return jax.device_put(
-            jnp.asarray(X, jnp.float32), NamedSharding(mesh, P(AX, None))
-        )
-
-    Xp, Xn = _pad_put(X_pos), _pad_put(X_neg)
+    Xp, Xn = pad_put(X_pos, mesh), pad_put(X_neg, mesh)
     params = jax.device_put(
         jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params),
         replicated,
     )
 
     def draw_blocks(key, n, m):
-        if cfg.scheme == "swor":
-            return (
-                jax.random.permutation(key, n)[: N * m]
-                .reshape(N, m).astype(jnp.int32)
-            )
-        return jax.random.randint(key, (N, m), 0, n, dtype=jnp.int32)
+        return _draw(key, n, N, cfg.scheme, m=m)
 
     def sgd_body(params, a, b, key):
         """One worker's step: local pair gradient, pmean, update.
@@ -232,7 +218,7 @@ def train_pairwise_numpy(
     rng = np.random.default_rng(cfg.seed)
     N = cfg.n_workers
     losses = []
-    parts = partition_two_sample(len(X_pos), len(X_neg), N, rng, cfg.scheme)
+    parts = None  # drawn by the t=0 refresh below
     for t in range(cfg.steps):
         if t % cfg.repartition_every == 0:
             parts = partition_two_sample(

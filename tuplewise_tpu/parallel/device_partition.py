@@ -1,0 +1,53 @@
+"""On-device partitioning helpers shared by the mesh backend, the
+learner, and the harness [SURVEY §2 L2 — device side].
+
+Host-side partitioning lives in parallel.partition (NumPy, importable
+without jax); these are the `jax.random` equivalents used inside jitted
+programs. One implementation so SWOR/SWR semantics can never diverge
+between the estimator backend, the trainer, and the experiment harness.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tuplewise_tpu.parallel.mesh import shard_axis_name as AX
+
+
+def draw_blocks(key, n: int, n_workers: int, scheme: str = "swor",
+                m: Optional[int] = None) -> jnp.ndarray:
+    """[N, m] int32 worker index blocks over range(n).
+
+    swor: one global permutation cut into N blocks (random remainder
+    dropped when n > N*m); swr: i.i.d. uniform draws. Mirrors
+    partition.partition_indices.
+    """
+    m = n // n_workers if m is None else m
+    if scheme == "swor":
+        idx = jax.random.permutation(key, n)[: n_workers * m]
+        return idx.reshape(n_workers, m).astype(jnp.int32)
+    if scheme == "swr":
+        return jax.random.randint(key, (n_workers, m), 0, n, dtype=jnp.int32)
+    raise ValueError(f"unknown partition scheme {scheme!r}")
+
+
+def pad_put(X, mesh: Mesh, dtype=jnp.float32) -> jnp.ndarray:
+    """Zero-pad axis 0 to a multiple of the mesh size and device_put
+    sharded on the worker axis.
+
+    Padding (never truncation) keeps every real row reachable: callers
+    draw indices over the TRUE n, so padded rows are never gathered and
+    ragged sizes drop a random remainder per round.
+    """
+    X = np.asarray(X)
+    n_shards = int(np.prod(mesh.devices.shape))
+    pad = (-len(X)) % n_shards
+    if pad:
+        X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
+    spec = P(AX, *([None] * (X.ndim - 1)))
+    return jax.device_put(jnp.asarray(X, dtype), NamedSharding(mesh, spec))
