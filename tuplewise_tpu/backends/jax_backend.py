@@ -44,11 +44,23 @@ class JaxBackend:
         tile_a: int = 1024,
         tile_b: int = 1024,
         triplet_tile: int = 128,
+        impl: str = "xla",
+        auc_fast: bool = True,
     ):
+        """impl: "xla" (tiled lax.scan reductions, default) or "pallas"
+        (hand-written TPU kernel for unmasked diff-kernel complete sums;
+        falls back to XLA when sizes aren't tile multiples).
+        auc_fast: complete() for the exact "auc" kernel uses the
+        O(n log n) rank formulation (ops.rank_auc) instead of streaming
+        the pair grid — identical value, orders of magnitude faster."""
+        if impl not in ("xla", "pallas"):
+            raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
         self.kernel = get_kernel(kernel)
         self.dtype = dtype
         self.tile_a, self.tile_b = tile_a, tile_b
         self.triplet_tile = triplet_tile
+        self.impl = impl
+        self.auc_fast = auc_fast
         k = self.kernel
 
         # ---- complete ------------------------------------------------- #
@@ -56,6 +68,25 @@ class JaxBackend:
             if k.kind == "triplet":
                 s, c = pair_tiles.triplet_stats(k, A, B, tile=triplet_tile)
             elif k.two_sample:
+                if auc_fast and k.name == "auc":
+                    from tuplewise_tpu.ops.rank_auc import rank_auc
+
+                    return rank_auc(A, B)
+                platform = jax.devices()[0].platform
+                if (impl == "pallas" and k.kind == "diff"
+                        and platform in ("tpu", "cpu")  # gpu: XLA path
+                        and A.shape[0] % tile_a == 0
+                        and B.shape[0] % tile_b == 0):
+                    from tuplewise_tpu.ops.pallas_pairs import pallas_pair_sum
+
+                    s = pallas_pair_sum(
+                        A, B, kernel=k,
+                        tile_a=tile_a, tile_b=tile_b,
+                        interpret=platform == "cpu",
+                    )
+                    return s / jnp.asarray(
+                        A.shape[0] * B.shape[0], s.dtype
+                    )
                 s, c = pair_tiles.pair_stats(
                     k, A, B, tile_a=tile_a, tile_b=tile_b
                 )

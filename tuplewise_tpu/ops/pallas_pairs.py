@@ -1,0 +1,91 @@
+"""Pallas TPU kernel for the score-difference pair-sum hot loop.
+
+The complete-U inner loop for diff kernels is elementwise VPU work over
+the [n1, n2] difference grid. This kernel controls the layout explicitly:
+the resident score block enters as a COLUMN [Ta, 1] (sublanes) and the
+visiting block as a ROW [1, Tb] (lanes), so the broadcasted subtraction
+is the natural sublane x lane outer pattern, computed tile-by-tile in
+VMEM. Partial sums accumulate per ROW-BLOCK into a [g1, 1] SMEM cell
+revisited across the sequential inner grid (O(n1/Ta) scalars, never the
+O(n1*n2/(Ta*Tb)) per-cell grid), and the row partials tree-reduce
+outside.
+
+The g(d) body comes from the Kernel's own diff_fn (ops.kernels) — no
+duplicated surrogate definitions. Used for unmasked complete statistics;
+masked, id-aware, and differentiating callers use ops.pair_tiles (XLA).
+CPU test execution uses interpret mode [pallas_guide: interpret=True].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tuplewise_tpu.ops.kernels import Kernel
+
+
+def _pair_sum_kernel(a_ref, b_ref, o_ref, *, g):
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[i, 0] = 0.0
+
+    # [Ta, 1] - [1, Tb] -> [Ta, Tb] sublane x lane broadcast
+    d = a_ref[:, :] - b_ref[:, :]
+    o_ref[i, 0] += jnp.sum(g(d))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kernel", "tile_a", "tile_b", "interpret")
+)
+def pallas_pair_sum(
+    s1: jnp.ndarray,
+    s2: jnp.ndarray,
+    *,
+    kernel: Kernel,
+    tile_a: int = 256,
+    tile_b: int = 4096,
+    interpret: bool = False,
+):
+    """Sum of g(s1_i - s2_j) over the full pair grid (no masks/ids).
+
+    Requires a diff kernel and len(s1) % tile_a == len(s2) % tile_b == 0
+    — callers (JaxBackend) fall back to the XLA path otherwise. Returns
+    an f32 scalar; count is len(s1) * len(s2) by construction.
+    """
+    if kernel.kind != "diff":
+        raise ValueError(
+            f"pallas pair-sum handles diff kernels only, got "
+            f"{kernel.name!r} (kind={kernel.kind})"
+        )
+    n1, n2 = s1.shape[0], s2.shape[0]
+    if n1 % tile_a or n2 % tile_b:
+        raise ValueError(
+            f"sizes ({n1}, {n2}) must be multiples of tiles "
+            f"({tile_a}, {tile_b})"
+        )
+    g1, g2 = n1 // tile_a, n2 // tile_b
+    col = s1.reshape(n1, 1)
+    row = s2.reshape(1, n2)
+    partials = pl.pallas_call(
+        functools.partial(
+            _pair_sum_kernel, g=lambda d: kernel.diff(d, jnp)
+        ),
+        out_shape=jax.ShapeDtypeStruct((g1, 1), jnp.float32),
+        grid=(g1, g2),
+        in_specs=[
+            pl.BlockSpec((tile_a, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tile_b), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (g1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM
+        ),
+        interpret=interpret,
+    )(col, row)
+    # tree-reduce the per-row-block partials
+    return jnp.sum(partials)

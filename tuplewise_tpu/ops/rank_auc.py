@@ -1,0 +1,40 @@
+"""O(n log n) exact complete AUC on device — the special-case fast path.
+
+The AUC U-statistic has closed-form rank structure (Mann-Whitney): with
+midranks for ties,
+
+    U_n = ( sum of pos midranks - n1 (n1 + 1) / 2 ) / (n1 n2)
+
+so the complete statistic needs one sort + two binary searches instead
+of streaming n1*n2 kernel evaluations: at n=10^7 that's ~10^8 work
+instead of 10^14 pairs. Mirrors models.metrics.auc_score (the NumPy
+oracle); exact for the "auc" kernel only — general kernels use the
+tiled reductions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def rank_auc(pos_scores: jnp.ndarray, neg_scores: jnp.ndarray) -> jnp.ndarray:
+    """AUC = P(s_pos > s_neg) + 0.5 P(s_pos = s_neg), cancellation-free.
+
+    Formulated per POSITIVE against the sorted negatives: each positive
+    contributes (count_less + 0.5 * count_equal) / n2, a value in [0, 1],
+    and the AUC is the mean of those fractions. No giant-midrank
+    subtraction appears anywhere, so f32 stays accurate (~n * eps
+    relative over the mean) at any n1/n2 scale or imbalance — unlike the
+    classical rank-sum formula, which subtracts two O(n^2)-magnitude
+    terms and loses 3-4 decimals in f32 at n ~ 1e7.
+    """
+    pos = pos_scores.ravel()
+    neg = jnp.sort(neg_scores.ravel())
+    n2 = neg.shape[0]
+    less = jnp.searchsorted(neg, pos, side="left")
+    leq = jnp.searchsorted(neg, pos, side="right")
+    frac = (less.astype(jnp.float32)
+            + 0.5 * (leq - less).astype(jnp.float32)) / n2
+    return jnp.mean(frac, dtype=jnp.float32)
