@@ -68,7 +68,12 @@ class JaxBackend:
             if k.kind == "triplet":
                 s, c = pair_tiles.triplet_stats(k, A, B, tile=triplet_tile)
             elif k.two_sample:
-                if auc_fast and k.name == "auc":
+                from tuplewise_tpu.ops.kernels import auc_kernel
+
+                # identity check, not name: a user kernel registered under
+                # the name "auc" with a different diff_fn must NOT be
+                # silently replaced by the rank formulation
+                if auc_fast and k is auc_kernel:
                     from tuplewise_tpu.ops.rank_auc import rank_auc
 
                     return rank_auc(A, B)

@@ -5,10 +5,10 @@ the [n1, n2] difference grid. This kernel controls the layout explicitly:
 the resident score block enters as a COLUMN [Ta, 1] (sublanes) and the
 visiting block as a ROW [1, Tb] (lanes), so the broadcasted subtraction
 is the natural sublane x lane outer pattern, computed tile-by-tile in
-VMEM. Partial sums accumulate per ROW-BLOCK into a [g1, 1] SMEM cell
-revisited across the sequential inner grid (O(n1/Ta) scalars, never the
-O(n1*n2/(Ta*Tb)) per-cell grid), and the row partials tree-reduce
-outside.
+VMEM. Partial sums accumulate per ROW-BLOCK into a [g1, 2] SMEM
+(sum, Kahan compensation) cell revisited across the sequential inner
+grid (O(n1/Ta) scalars, never the O(n1*n2/(Ta*Tb)) per-cell grid), and
+the row partials tree-reduce outside.
 
 The g(d) body comes from the Kernel's own diff_fn (ops.kernels) — no
 duplicated surrogate definitions. Used for unmasked complete statistics;
@@ -34,10 +34,19 @@ def _pair_sum_kernel(a_ref, b_ref, o_ref, *, g):
     @pl.when(j == 0)
     def _init():
         o_ref[i, 0] = 0.0
+        o_ref[i, 1] = 0.0
 
     # [Ta, 1] - [1, Tb] -> [Ta, Tb] sublane x lane broadcast
     d = a_ref[:, :] - b_ref[:, :]
-    o_ref[i, 0] += jnp.sum(g(d))
+    x = jnp.sum(g(d))
+    # Kahan-compensated add into the (sum, comp) SMEM cell: a row-block
+    # accumulator spans tile_a * n2 pairs (~1e10 at n=1e7), where plain
+    # f32 += would round away ~tile-sized increments — the same numerics
+    # contract as pair_tiles._kahan_add.
+    y = x - o_ref[i, 1]
+    t = o_ref[i, 0] + y
+    o_ref[i, 1] = (t - o_ref[i, 0]) - y
+    o_ref[i, 0] = t
 
 
 @functools.partial(
@@ -76,16 +85,16 @@ def pallas_pair_sum(
         functools.partial(
             _pair_sum_kernel, g=lambda d: kernel.diff(d, jnp)
         ),
-        out_shape=jax.ShapeDtypeStruct((g1, 1), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((g1, 2), jnp.float32),
         grid=(g1, g2),
         in_specs=[
             pl.BlockSpec((tile_a, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((1, tile_b), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec(
-            (g1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM
+            (g1, 2), lambda i, j: (0, 0), memory_space=pltpu.SMEM
         ),
         interpret=interpret,
     )(col, row)
-    # tree-reduce the per-row-block partials
-    return jnp.sum(partials)
+    # tree-reduce the per-row-block (sum + compensation) partials
+    return jnp.sum(partials[:, 0] + partials[:, 1])
