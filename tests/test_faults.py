@@ -1,0 +1,159 @@
+"""Fault tolerance: drop-and-renormalize + failure detection [SURVEY §5.4].
+
+The key properties:
+* dropping workers leaves local-average / repartitioned estimators
+  UNBIASED (each surviving worker's local U is unbiased on its own);
+* the dropped-worker value equals the hand-computed mean over the
+  surviving workers' per-worker values (exact renormalization, not an
+  approximation);
+* the numpy oracle and jax backend agree exactly for the same partition
+  draw is NOT promised (different RNGs) — parity here is structural:
+  identical semantics checked independently per backend.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tuplewise_tpu import Estimator
+from tuplewise_tpu.data import make_gaussians
+from tuplewise_tpu.parallel.faults import (
+    alive_mask,
+    check_mesh_health,
+    normalize_dropped,
+    sample_failures,
+    survivors,
+)
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (virtual) devices"
+)
+
+
+@pytest.fixture(scope="module")
+def scores():
+    X, Y = make_gaussians(1600, 1600, dim=1, separation=1.0, seed=3)
+    return X[:, 0], Y[:, 0]
+
+
+class TestFaultHelpers:
+    def test_normalize_and_mask(self):
+        assert normalize_dropped([3, 1, 1], 4) == (1, 3)
+        assert alive_mask(4, (1, 3)).tolist() == [1.0, 0.0, 1.0, 0.0]
+        assert survivors(4, (1, 3)) == (0, 2)
+
+    def test_cannot_drop_all(self):
+        with pytest.raises(ValueError, match="cannot drop all"):
+            normalize_dropped(range(4), 4)
+
+    def test_out_of_range(self):
+        with pytest.raises(ValueError, match="out of range"):
+            normalize_dropped([4], 4)
+
+    def test_sample_failures_leaves_survivor(self):
+        for seed in range(20):
+            dropped = sample_failures(seed, 4, 0.9)
+            assert len(dropped) < 4
+
+    def test_sample_failures_rate(self):
+        counts = [len(sample_failures(s, 16, 0.25)) for s in range(200)]
+        assert 2.0 < np.mean(counts) < 6.0  # E = 4
+
+
+class TestDropRenormalizeOracle:
+    def test_equals_survivor_mean(self, scores):
+        """Dropping workers == averaging the survivors' per-worker
+        values, computed here independently from the same partition."""
+        s1, s2 = scores
+        from tuplewise_tpu.backends.numpy_backend import NumpyBackend
+        from tuplewise_tpu.ops.kernels import auc_kernel
+        from tuplewise_tpu.parallel.partition import partition_two_sample
+
+        be = NumpyBackend(auc_kernel)
+        rng = np.random.default_rng(11)
+        pi, ni = partition_two_sample(len(s1), len(s2), 4, rng, "swor")
+        per_worker = []
+        for w in range(4):
+            s, c = be._pair_stats(s1[pi[w]], s2[ni[w]])
+            per_worker.append(s / c)
+        got = be.local_average(
+            s1, s2, n_workers=4, seed=11, scheme="swor",
+            dropped_workers=(1, 2),
+        )
+        assert abs(got - np.mean([per_worker[0], per_worker[3]])) < 1e-12
+
+    def test_unbiased_under_failures(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy", n_workers=4)
+        u_n = est.complete(s1, s2)
+        vals = [
+            est.local_average(s1, s2, seed=m, dropped_workers=(2,))
+            for m in range(40)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
+        assert abs(np.mean(vals) - u_n) < 5 * se
+
+    def test_repartitioned_with_failures(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy", n_workers=4)
+        u_n = est.complete(s1, s2)
+        vals = [
+            est.repartitioned(
+                s1, s2, n_rounds=3, seed=m, dropped_workers=(0,)
+            )
+            for m in range(25)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
+        assert abs(np.mean(vals) - u_n) < 5 * se
+
+
+class TestDropRenormalizeJax:
+    def test_unbiased_under_failures(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="jax", n_workers=4,
+                        tile_a=128, tile_b=128)
+        u_n = Estimator("auc", backend="numpy").complete(s1, s2)
+        vals = [
+            est.local_average(s1, s2, seed=m, dropped_workers=(1, 3))
+            for m in range(40)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
+        assert abs(np.mean(vals) - u_n) < 5 * se
+
+    def test_dropped_changes_value_but_not_shape(self, scores):
+        """Same seed, different failure sets -> different values (the
+        mask is live, not ignored), with no recompilation error."""
+        s1, s2 = scores
+        est = Estimator("auc", backend="jax", n_workers=4,
+                        tile_a=128, tile_b=128)
+        full = est.local_average(s1, s2, seed=0)
+        drop = est.local_average(s1, s2, seed=0, dropped_workers=(2,))
+        assert full != drop
+
+
+@needs_mesh
+class TestDropRenormalizeMesh:
+    def test_unbiased_under_failures(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="mesh", n_workers=8,
+                        tile_a=64, tile_b=64)
+        u_n = Estimator("auc", backend="numpy").complete(s1, s2)
+        vals = [
+            est.local_average(s1, s2, seed=m, dropped_workers=(0, 5))
+            for m in range(30)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
+        assert abs(np.mean(vals) - u_n) < 5 * se
+
+    def test_repartitioned_with_failures(self, scores):
+        s1, s2 = scores
+        est = Estimator("auc", backend="mesh", n_workers=8,
+                        tile_a=64, tile_b=64)
+        v = est.repartitioned(s1, s2, n_rounds=2, seed=0,
+                              dropped_workers=(3,))
+        assert 0.0 < v < 1.0
+
+    def test_health_check(self):
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        assert check_mesh_health(make_mesh(8))

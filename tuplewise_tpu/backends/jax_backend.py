@@ -113,8 +113,12 @@ class JaxBackend:
                 return idx.reshape(n_workers, m)
             return jax.random.randint(key, (n_workers, m), 0, n)
 
-        def local_round(A, B, key, n_workers, scheme):
-            """One local-average round; workers are a vmap axis."""
+        def local_round(A, B, key, alive, n_workers, scheme):
+            """One local-average round; workers are a vmap axis. ``alive``
+            is a {0,1} float [n_workers] mask: dropped workers' values are
+            excluded and the mean renormalizes over survivors
+            (drop-and-renormalize, parallel.faults / SURVEY §5.4).
+            Passed as a traced array so failure sets don't recompile."""
             if k.two_sample:  # incl. triplet (degree-(2,1))
                 k1, k2 = jax.random.split(key)
                 i1 = draw_blocks(k1, A.shape[0], n_workers, scheme)
@@ -144,17 +148,20 @@ class JaxBackend:
                     )
                     return s / c.astype(s.dtype)
                 vals = jax.vmap(worker)(Ab, idx.astype(jnp.int32))
-            return jnp.mean(vals)
+            alive = alive.astype(vals.dtype)
+            return jnp.sum(vals * alive) / jnp.sum(alive)
 
         self._local = jax.jit(
             local_round, static_argnames=("n_workers", "scheme")
         )
 
         # ---- repartitioned: scan over T reshuffle rounds -------------- #
-        def repartitioned_fn(A, B, key, n_workers, n_rounds, scheme):
+        def repartitioned_fn(A, B, key, alive, n_workers, n_rounds, scheme):
             def round_body(carry, t):
                 kt = fold(key, "repartition_round", t)
-                return carry + local_round(A, B, kt, n_workers, scheme), None
+                return carry + local_round(
+                    A, B, kt, alive, n_workers, scheme
+                ), None
 
             total, _ = lax.scan(
                 round_body, jnp.zeros((), A.dtype), jnp.arange(n_rounds)
@@ -193,19 +200,29 @@ class JaxBackend:
         return float(self._complete(A, B if B is not None else A)
                      if self.kernel.two_sample else self._complete(A, A))
 
-    def local_average(self, A, B=None, *, n_workers, seed=0, scheme="swor"):
+    def _alive(self, n_workers, dropped_workers):
+        from tuplewise_tpu.parallel.faults import alive_mask
+
+        return jnp.asarray(
+            alive_mask(n_workers, dropped_workers), self.dtype
+        )
+
+    def local_average(self, A, B=None, *, n_workers, seed=0, scheme="swor",
+                      dropped_workers=()):
         A, B = self._dev(A, B)
         key = fold(root_key(seed), "local_average")
         return float(self._local(
             A, B if B is not None else A, key,
+            self._alive(n_workers, dropped_workers),
             n_workers=n_workers, scheme=scheme))
 
     def repartitioned(self, A, B=None, *, n_workers, n_rounds,
-                      seed=0, scheme="swor"):
+                      seed=0, scheme="swor", dropped_workers=()):
         A, B = self._dev(A, B)
         key = root_key(seed)
         return float(self._repart(
             A, B if B is not None else A, key,
+            self._alive(n_workers, dropped_workers),
             n_workers=n_workers, n_rounds=n_rounds, scheme=scheme))
 
     def incomplete(self, A, B=None, *, n_pairs, seed=0):

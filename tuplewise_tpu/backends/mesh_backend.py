@@ -135,14 +135,18 @@ class MeshBackend:
             check_vma=False,
         )
 
-        def one_round(A, B, key, n1, n2, scheme):
+        def one_round(A, B, key, alive, n1, n2, scheme):
             """Gather fresh worker blocks (XLA shuffles across chips) and
             psum the per-worker means.
 
             A/B are zero-padded to a multiple of N; n1/n2 are the true
             sizes, so permutations range over real rows only and the
             remainder dropped each round is RANDOM (unbiased), matching
-            the host partitioner's semantics."""
+            the host partitioner's semantics.
+
+            ``alive`` is a {0,1} float [N] mask: chips listed as dropped
+            are excluded and the mean renormalizes over survivors
+            (drop-and-renormalize, parallel.faults / SURVEY §5.4)."""
             if k.two_sample:
                 k1, k2 = jax.random.split(key)
                 i1 = draw_blocks(k1, n1, scheme)
@@ -159,16 +163,17 @@ class MeshBackend:
                 i1 = draw_blocks(key, n1, scheme)
                 Ab = A.at[i1].get(out_sharding=shard2)
                 vals = local_mean_smap(Ab, i1, Ab, i1)
-            return jnp.mean(vals)
+            alive = alive.astype(vals.dtype)
+            return jnp.sum(vals * alive) / jnp.sum(alive)
 
         self._local = jax.jit(
             one_round, static_argnames=("n1", "n2", "scheme")
         )
 
-        def repart_fn(A, B, key, n1, n2, n_rounds, scheme):
+        def repart_fn(A, B, key, alive, n1, n2, n_rounds, scheme):
             def body(carry, t):
                 kt = fold(key, "repartition_round", t)
-                return carry + one_round(A, B, kt, n1, n2, scheme), None
+                return carry + one_round(A, B, kt, alive, n1, n2, scheme), None
 
             total, _ = lax.scan(
                 body, jnp.zeros((), dtype), jnp.arange(n_rounds)
@@ -264,8 +269,15 @@ class MeshBackend:
             b, mb, ib = a, ma, ia
         return float(self._complete(a, ma, ia, b, mb, ib))
 
+    def _alive(self, dropped_workers):
+        from tuplewise_tpu.parallel.faults import alive_mask
+
+        return jnp.asarray(
+            alive_mask(self.n_shards, dropped_workers), self.dtype
+        )
+
     def local_average(self, A, B=None, *, n_workers=None, seed=0,
-                      scheme="swor"):
+                      scheme="swor", dropped_workers=()):
         self._check_workers(n_workers)
         A, B = self._two(A, B)
         self._check_sizes(A, B)
@@ -273,17 +285,18 @@ class MeshBackend:
         Bg = Ag if B is A else self._global(B)
         key = fold(root_key(seed), "local_average")
         return float(self._local(
-            Ag, Bg, key, n1=len(A), n2=len(B), scheme=scheme))
+            Ag, Bg, key, self._alive(dropped_workers),
+            n1=len(A), n2=len(B), scheme=scheme))
 
     def repartitioned(self, A, B=None, *, n_workers=None, n_rounds,
-                      seed=0, scheme="swor"):
+                      seed=0, scheme="swor", dropped_workers=()):
         self._check_workers(n_workers)
         A, B = self._two(A, B)
         self._check_sizes(A, B)
         Ag = self._global(A)
         Bg = Ag if B is A else self._global(B)
         return float(self._repart(
-            Ag, Bg, root_key(seed),
+            Ag, Bg, root_key(seed), self._alive(dropped_workers),
             n1=len(A), n2=len(B), n_rounds=n_rounds, scheme=scheme))
 
     def incomplete(self, A, B=None, *, n_pairs, seed=0):

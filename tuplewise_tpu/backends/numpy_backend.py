@@ -114,28 +114,43 @@ class NumpyBackend:
         n_workers: int,
         seed: int = 0,
         scheme: str = "swor",
+        dropped_workers: tuple = (),
     ) -> float:
         """U^loc_N: mean of per-worker complete U over a proportional
-        partition [SURVEY §1.2 item 2, §4.2 inner loop]."""
+        partition [SURVEY §1.2 item 2, §4.2 inner loop]. Workers listed
+        in ``dropped_workers`` are treated as failed: their contribution
+        is dropped and the mean renormalizes over survivors
+        (parallel.faults, SURVEY §5.4)."""
         rng = np.random.default_rng(seed)
-        return self._local_average_once(A, B, n_workers, rng, scheme)
+        return self._local_average_once(
+            A, B, n_workers, rng, scheme, dropped_workers
+        )
 
-    def _local_average_once(self, A, B, n_workers, rng, scheme) -> float:
+    def _local_average_once(
+        self, A, B, n_workers, rng, scheme, dropped_workers=()
+    ) -> float:
+        from tuplewise_tpu.parallel.faults import survivors
+
         k = self.kernel
+        alive = survivors(n_workers, dropped_workers)
         vals = []
+        # NOTE: the partition is always drawn over ALL n_workers (failed
+        # workers' data is lost, not redistributed), then dropped entries
+        # are skipped — matching real drop-and-renormalize semantics and
+        # keeping the RNG stream identical with and without failures.
         if k.kind == "triplet":
             pi, ni = partition_two_sample(len(A), len(B), n_workers, rng, scheme)
-            for w in range(n_workers):
+            for w in alive:
                 s, c = self._triplet_stats(A[pi[w]], B[ni[w]], ids_x=pi[w])
                 vals.append(s / c)
         elif k.two_sample:
             pi, ni = partition_two_sample(len(A), len(B), n_workers, rng, scheme)
-            for w in range(n_workers):
+            for w in alive:
                 s, c = self._pair_stats(A[pi[w]], B[ni[w]])
                 vals.append(s / c)
         else:
             idx = partition_indices(len(A), n_workers, rng, scheme)
-            for w in range(n_workers):
+            for w in alive:
                 s, c = self._pair_stats(A[idx[w]], A[idx[w]], idx[w], idx[w])
                 vals.append(s / c)
         return float(np.mean(vals))
@@ -149,12 +164,17 @@ class NumpyBackend:
         n_rounds: int,
         seed: int = 0,
         scheme: str = "swor",
+        dropped_workers: tuple = (),
     ) -> float:
         """U_{N,T}: average of T local-average rounds, one reshuffle per
-        round — repartitions buy variance [SURVEY §1.2 item 3, §4.2]."""
+        round — repartitions buy variance [SURVEY §1.2 item 3, §4.2].
+        ``dropped_workers`` are excluded from every round (a failed
+        worker stays failed; drop-and-renormalize per SURVEY §5.4)."""
         rng = np.random.default_rng(seed)
         ests = [
-            self._local_average_once(A, B, n_workers, rng, scheme)
+            self._local_average_once(
+                A, B, n_workers, rng, scheme, dropped_workers
+            )
             for _ in range(n_rounds)
         ]
         return float(np.mean(ests))

@@ -107,26 +107,31 @@ class Estimator:
 
     def local_average(self, A, B=None, *, seed: int = 0,
                       scheme: str = "swor",
-                      n_workers: Optional[int] = None) -> float:
+                      n_workers: Optional[int] = None,
+                      dropped_workers: tuple = ()) -> float:
         """U^loc_N — per-worker complete U, averaged; zero repartition
         cost, extra variance from ignored cross-worker tuples
-        [SURVEY §1.2.2]."""
+        [SURVEY §1.2.2]. ``dropped_workers``: failed workers to exclude,
+        renormalizing over survivors (parallel.faults, SURVEY §5.4)."""
         A, B = self._prep(A, B)
         return float(self.backend.local_average(
             A, B, n_workers=self._resolve_workers(n_workers),
-            seed=seed, scheme=scheme))
+            seed=seed, scheme=scheme, dropped_workers=dropped_workers))
 
     def repartitioned(self, A, B=None, *, n_rounds: int, seed: int = 0,
                       scheme: str = "swor",
-                      n_workers: Optional[int] = None) -> float:
+                      n_workers: Optional[int] = None,
+                      dropped_workers: tuple = ()) -> float:
         """U_{N,T} — T reshuffle rounds of local averaging; communication
-        buys variance [SURVEY §1.2.3]."""
+        buys variance [SURVEY §1.2.3]. ``dropped_workers``: failed
+        workers excluded from every round (drop-and-renormalize)."""
         if n_rounds < 1:
             raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")
         A, B = self._prep(A, B)
         return float(self.backend.repartitioned(
             A, B, n_workers=self._resolve_workers(n_workers),
-            n_rounds=n_rounds, seed=seed, scheme=scheme))
+            n_rounds=n_rounds, seed=seed, scheme=scheme,
+            dropped_workers=dropped_workers))
 
     def incomplete(self, A, B=None, *, n_pairs: int, seed: int = 0) -> float:
         """U~_B — B tuples sampled with replacement [SURVEY §1.2.4]."""

@@ -85,7 +85,8 @@ def _acc_final(carry) -> Tuple[jnp.ndarray, jnp.ndarray]:
     pairs) — negligible against the f32 storage of the sum itself.
     """
     s, comp, lo, hi = carry
-    total = s + comp
+    # comp = (t - s) - y holds the NEGATIVE of the lost low-order bits
+    total = s - comp
     count = hi.astype(s.dtype) * s.dtype.type(_COUNT_RADIX) + lo.astype(s.dtype)
     return total, count
 

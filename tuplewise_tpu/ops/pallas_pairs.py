@@ -96,5 +96,7 @@ def pallas_pair_sum(
         ),
         interpret=interpret,
     )(col, row)
-    # tree-reduce the per-row-block (sum + compensation) partials
-    return jnp.sum(partials[:, 0] + partials[:, 1])
+    # tree-reduce the per-row-block partials, folding in each block's
+    # residual: comp = (t - s) - y accumulates the NEGATIVE of the lost
+    # low-order bits, so the true block sum is s - comp
+    return jnp.sum(partials[:, 0] - partials[:, 1])

@@ -2,6 +2,12 @@
 # it imports jax at module top, and the numpy oracle path must stay
 # importable without jax. Use `from tuplewise_tpu.parallel.mesh import
 # make_mesh, shard_axis_name` directly.
+from tuplewise_tpu.parallel.faults import (
+    alive_mask,
+    normalize_dropped,
+    sample_failures,
+    survivors,
+)
 from tuplewise_tpu.parallel.partition import (
     partition_indices,
     partition_two_sample,
@@ -10,8 +16,12 @@ from tuplewise_tpu.parallel.partition import (
 )
 
 __all__ = [
+    "alive_mask",
+    "normalize_dropped",
     "partition_indices",
     "partition_two_sample",
     "pack_shards",
     "pack_two_sample_shards",
+    "sample_failures",
+    "survivors",
 ]
