@@ -39,7 +39,7 @@ from tuplewise_tpu.backends.base import register_backend
 from tuplewise_tpu.ops import pair_tiles
 from tuplewise_tpu.ops.kernels import Kernel, get_kernel
 from tuplewise_tpu.parallel import ring
-from tuplewise_tpu.parallel.mesh import make_mesh, shard_axis_name as AX
+from tuplewise_tpu.parallel.mesh import make_mesh
 from tuplewise_tpu.parallel.partition import pack_all
 from tuplewise_tpu.utils.rng import fold, root_key
 
@@ -68,27 +68,51 @@ class MeshBackend:
         self.triplet_tile = triplet_tile
         k = self.kernel
         N = self.n_shards
+        # all mesh axes together form the worker axis: 1-D ("w",) meshes
+        # ride one ICI ring; 2-D ("dcn", "w") meshes use the hierarchical
+        # double ring so block rotation stays on ICI [SURVEY §5.8]
+        axes = tuple(self.mesh.axis_names)
+        self._axes = axes
+        if len(axes) > 2:
+            raise ValueError(f"mesh must be 1-D or 2-D, got axes {axes}")
+        if len(axes) == 2 and k.kind == "triplet":
+            raise ValueError(
+                "degree-3 kernels currently require a 1-D mesh (the "
+                "triplet double-ring does not yet nest over dcn)"
+            )
+        PA = P(axes)  # shard axis 0 over every mesh axis
 
-        shard2 = NamedSharding(self.mesh, P(AX))          # [N, ...] blocks
+        shard2 = NamedSharding(self.mesh, PA)             # [N, ...] blocks
         self._block_sharding = shard2
 
         # ---- complete: ring over the mesh ----------------------------- #
         def complete_body(a, ma, ia, b, mb, ib):
             # local blocks arrive as [1, cap, ...]; drop the shard axis
-            s, c = (
-                ring.ring_triplet_stats(
+            # axis names come from the mesh itself: the TRAILING axis is
+            # the fast ICI ring, a leading axis (if any) is DCN — no
+            # particular name is required
+            if k.kind == "triplet":
+                s, c = ring.ring_triplet_stats(
                     k, a[0], b[0], mask_x=ma[0], mask_y=mb[0], ids_x=ia[0],
-                    axis_name=AX, tile=triplet_tile,
+                    axis_name=axes[-1], tile=triplet_tile,
                 )
-                if k.kind == "triplet"
-                else ring.ring_pair_stats(
+            elif len(axes) == 2:
+                s, c = ring.ring_pair_stats_2d(
                     k, a[0], b[0],
                     mask_a=ma[0], mask_b=mb[0],
                     ids_a=None if k.two_sample else ia[0],
                     ids_b=None if k.two_sample else ib[0],
-                    axis_name=AX, tile_a=tile_a, tile_b=tile_b,
+                    ici_axis=axes[1], dcn_axis=axes[0],
+                    tile_a=tile_a, tile_b=tile_b,
                 )
-            )
+            else:
+                s, c = ring.ring_pair_stats(
+                    k, a[0], b[0],
+                    mask_a=ma[0], mask_b=mb[0],
+                    ids_a=None if k.two_sample else ia[0],
+                    ids_b=None if k.two_sample else ib[0],
+                    axis_name=axes[0], tile_a=tile_a, tile_b=tile_b,
+                )
             return s, c
 
         @jax.jit
@@ -96,7 +120,7 @@ class MeshBackend:
             s, c = jax.shard_map(
                 complete_body,
                 mesh=self.mesh,
-                in_specs=(P(AX), P(AX), P(AX), P(AX), P(AX), P(AX)),
+                in_specs=(PA, PA, PA, PA, PA, PA),
                 out_specs=(P(), P()),
                 check_vma=False,
             )(a, ma, ia, b, mb, ib)
@@ -130,8 +154,8 @@ class MeshBackend:
         local_mean_smap = jax.shard_map(
             local_mean_body,
             mesh=self.mesh,
-            in_specs=(P(AX), P(AX), P(AX), P(AX)),
-            out_specs=P(AX),
+            in_specs=(PA, PA, PA, PA),
+            out_specs=PA,
             check_vma=False,
         )
 
@@ -191,7 +215,10 @@ class MeshBackend:
             (pack_shards packs valid rows first; pack_all only pads the
             tail shard — we sample indices < valid_count)."""
             del ma, mb  # blocks come from pack_partition: no padding
-            shard = lax.axis_index(AX)
+            # linearized shard id across all mesh axes
+            shard = lax.axis_index(axes[0])
+            for ax in axes[1:]:
+                shard = shard * lax.axis_size(ax) + lax.axis_index(ax)
             kk = fold(key, "shard", shard)
             per = -(-n_pairs // N)  # ceil: draw AT LEAST n_pairs total
             a0, b0 = a[0], b[0]
@@ -209,13 +236,13 @@ class MeshBackend:
                 i, j = pair_tiles.sample_pair_indices(kk, na, na, per, True)
                 vals = k.pair_elementwise(a0[i], a0[j], jnp)
             del ia, ib
-            return lax.pmean(jnp.mean(vals, dtype=a.dtype), AX)
+            return lax.pmean(jnp.mean(vals, dtype=a.dtype), axes)
 
         def incomplete_fn(key, a, ma, ia, b, mb, ib, n_pairs):
             return jax.shard_map(
                 functools.partial(incomplete_body, n_pairs=n_pairs),
                 mesh=self.mesh,
-                in_specs=(P(), P(AX), P(AX), P(AX), P(AX), P(AX), P(AX)),
+                in_specs=(P(), PA, PA, PA, PA, PA, PA),
                 out_specs=P(),
                 check_vma=False,
             )(key, a, ma, ia, b, mb, ib)

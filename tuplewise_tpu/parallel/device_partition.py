@@ -16,8 +16,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tuplewise_tpu.parallel.mesh import shard_axis_name as AX
-
 
 def draw_blocks(key, n: int, n_workers: int, scheme: str = "swor",
                 m: Optional[int] = None) -> jnp.ndarray:
@@ -49,5 +47,6 @@ def pad_put(X, mesh: Mesh, dtype=jnp.float32) -> jnp.ndarray:
     pad = (-len(X)) % n_shards
     if pad:
         X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
-    spec = P(AX, *([None] * (X.ndim - 1)))
+    # shard axis 0 over EVERY mesh axis (1-D and 2-D meshes alike)
+    spec = P(tuple(mesh.axis_names), *([None] * (X.ndim - 1)))
     return jax.device_put(jnp.asarray(X, dtype), NamedSharding(mesh, spec))

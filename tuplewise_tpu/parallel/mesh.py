@@ -14,6 +14,7 @@ import jax
 from jax.sharding import Mesh
 
 shard_axis_name = "w"
+dcn_axis_name = "dcn"
 
 
 def make_mesh(n_workers: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -27,3 +28,37 @@ def make_mesh(n_workers: Optional[int] = None, devices: Optional[Sequence] = Non
             f"requested {n_workers} workers but only {len(devices)} devices"
         )
     return jax.make_mesh((n_workers,), (shard_axis_name,), devices=devices[:n_workers])
+
+
+def make_mesh_2d(
+    n_dcn: int,
+    n_ici: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A 2-D ``(dcn, ici)``-named mesh for multi-host topologies
+    [SURVEY §5.8]: the trailing ("w") axis is the fast intra-slice ICI
+    ring; the leading ("dcn") axis spans host/slice boundaries. The ring
+    primitives rotate blocks over "w" and cross "dcn" once per full
+    inner cycle (ring_pair_stats_2d), so collectives ride ICI, not DCN.
+
+    On a real multi-host system pass ``devices`` ordered so consecutive
+    groups of ``n_ici`` share a slice (jax.devices() already is).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_ici is None:
+        if len(devices) % n_dcn:
+            raise ValueError(
+                f"{len(devices)} devices do not divide into {n_dcn} hosts"
+            )
+        n_ici = len(devices) // n_dcn
+    need = n_dcn * n_ici
+    if need > len(devices):
+        raise ValueError(
+            f"requested {n_dcn}x{n_ici} mesh but only "
+            f"{len(devices)} devices"
+        )
+    return jax.make_mesh(
+        (n_dcn, n_ici), (dcn_axis_name, shard_axis_name),
+        devices=devices[:need],
+    )

@@ -31,6 +31,41 @@ def _ring_perm(axis_name):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+def _rotate(state, axis_name):
+    """ppermute every array of a visiting-block state one ring step."""
+    perm = _ring_perm(axis_name)
+    return tuple(lax.ppermute(x, axis_name, perm) for x in state)
+
+
+def _ring_accumulate(
+    kernel, a, mask_a, ids_a, visiting, *,
+    axis_name, tile_a, tile_b, use_ids, acc,
+):
+    """One full rotation of the visiting (b, mask, ids) state around
+    ``axis_name``, accumulating tiled pair stats against the resident
+    block at every stop. Returns (acc, visiting) with the visiting state
+    back at its starting shard (a full cycle is the identity
+    permutation), so callers can nest rotations hierarchically."""
+    n_shards = lax.axis_size(axis_name)
+
+    def step(carry, _):
+        (s, c), vis = carry
+        bv, mbv, ibv = vis
+        ds, dc = pair_tiles.pair_stats(
+            kernel, a, bv,
+            mask_a=mask_a, mask_b=mbv,
+            ids_a=ids_a if use_ids else None,
+            ids_b=ibv if use_ids else None,
+            tile_a=tile_a, tile_b=tile_b,
+        )
+        return ((s + ds, c + dc), _rotate(vis, axis_name)), None
+
+    (acc, visiting), _ = lax.scan(
+        step, (acc, visiting), None, length=n_shards
+    )
+    return acc, visiting
+
+
 def ring_pair_stats(
     kernel,
     a: jnp.ndarray,
@@ -59,30 +94,72 @@ def ring_pair_stats(
             "ring_pair_stats needs BOTH ids_a and ids_b (or neither); "
             "a lone ids side would silently mis-exclude pairs"
         )
-    n_shards = lax.axis_size(axis_name)
     dtype = a.dtype
     mb = jnp.ones(b.shape[0], dtype) if mask_b is None else mask_b
     use_ids = ids_a is not None
     ib = jnp.zeros(b.shape[0], jnp.int32) if ids_b is None else ids_b.astype(jnp.int32)
-    perm = _ring_perm(axis_name)
 
-    def step(carry, _):
-        s, c, bv, mbv, ibv = carry
-        ds, dc = pair_tiles.pair_stats(
-            kernel, a, bv,
-            mask_a=mask_a, mask_b=mbv,
-            ids_a=ids_a if use_ids else None,
-            ids_b=ibv if use_ids else None,
-            tile_a=tile_a, tile_b=tile_b,
-        )
-        bv = lax.ppermute(bv, axis_name, perm)
-        mbv = lax.ppermute(mbv, axis_name, perm)
-        ibv = lax.ppermute(ibv, axis_name, perm)
-        return (s + ds, c + dc, bv, mbv, ibv), None
-
-    init = (jnp.zeros((), dtype), jnp.zeros((), dtype), b, mb, ib)
-    (s, c, _, _, _), _ = lax.scan(step, init, None, length=n_shards)
+    (s, c), _ = _ring_accumulate(
+        kernel, a, mask_a, ids_a, (b, mb, ib),
+        axis_name=axis_name, tile_a=tile_a, tile_b=tile_b,
+        use_ids=use_ids,
+        acc=(jnp.zeros((), dtype), jnp.zeros((), dtype)),
+    )
     return lax.psum(s, axis_name), lax.psum(c, axis_name)
+
+
+def ring_pair_stats_2d(
+    kernel,
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    mask_a: Optional[jnp.ndarray] = None,
+    mask_b: Optional[jnp.ndarray] = None,
+    ids_a: Optional[jnp.ndarray] = None,
+    ids_b: Optional[jnp.ndarray] = None,
+    *,
+    ici_axis: str,
+    dcn_axis: str,
+    tile_a: int = 1024,
+    tile_b: int = 1024,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Hierarchical cross-shard all-pairs over a 2-D (dcn, ici) mesh —
+    the multi-host layout of [SURVEY §5.8]: chips within a host/pod slice
+    are connected by fast ICI; hosts by slow DCN.
+
+    Double ring, communication-hierarchy-aware: the visiting block does a
+    FULL ici rotation (fast, I-1 hops per cycle) for every ONE dcn
+    rotation (slow, D-1 hops total), so each device sees every b block
+    while DCN carries only D-1 block transfers per device instead of the
+    D*I-1 a flat ring over all devices would route across host
+    boundaries. Same invariance contract as ring_pair_stats: returns the
+    (sum, count) of the single-device computation, psum'd over both axes.
+    """
+    if (ids_a is None) != (ids_b is None):
+        raise ValueError(
+            "ring_pair_stats_2d needs BOTH ids_a and ids_b (or neither)"
+        )
+    dtype = a.dtype
+    mb = jnp.ones(b.shape[0], dtype) if mask_b is None else mask_b
+    use_ids = ids_a is not None
+    ib = jnp.zeros(b.shape[0], jnp.int32) if ids_b is None else ids_b.astype(jnp.int32)
+    n_dcn = lax.axis_size(dcn_axis)
+
+    def outer(carry, _):
+        acc, vis = carry
+        acc, vis = _ring_accumulate(
+            kernel, a, mask_a, ids_a, vis,
+            axis_name=ici_axis, tile_a=tile_a, tile_b=tile_b,
+            use_ids=use_ids, acc=acc,
+        )
+        return (acc, _rotate(vis, dcn_axis)), None
+
+    init = (
+        (jnp.zeros((), dtype), jnp.zeros((), dtype)),
+        (b, mb, ib),
+    )
+    ((s, c), _), _ = lax.scan(outer, init, None, length=n_dcn)
+    both = (dcn_axis, ici_axis)
+    return lax.psum(s, both), lax.psum(c, both)
 
 
 def ring_triplet_stats(
