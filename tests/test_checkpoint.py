@@ -1,0 +1,203 @@
+"""Checkpoint/resume [SURVEY §5.5].
+
+The contract is EXACT resume: because every source of randomness is
+keyed by absolute step/rep index (utils.rng.fold), a run chunked at any
+checkpoint boundary — including one interrupted and resumed in a fresh
+process — reproduces the unchunked run bit-for-bit.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.data import make_gaussians
+from tuplewise_tpu.harness.variance import VarianceConfig, run_variance_experiment
+from tuplewise_tpu.models.pairwise_sgd import TrainConfig, train_pairwise
+from tuplewise_tpu.models.scorers import LinearScorer
+from tuplewise_tpu.utils.checkpoint import (
+    check_config,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+class TestCheckpointFile:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(
+            p, step=7,
+            params={"w": np.arange(3.0), "b": np.asarray(0.5)},
+            extra={"loss": np.asarray([1.0, 0.5])},
+            config={"lr": 0.1, "steps": 10},
+        )
+        ck = load_checkpoint(p)
+        assert ck["step"] == 7
+        np.testing.assert_array_equal(ck["params"]["w"], np.arange(3.0))
+        np.testing.assert_array_equal(ck["extra"]["loss"], [1.0, 0.5])
+        assert ck["config"] == {"lr": 0.1, "steps": 10}
+
+    def test_missing_returns_none(self, tmp_path):
+        assert load_checkpoint(str(tmp_path / "nope.npz")) is None
+
+    def test_config_mismatch_raises(self):
+        with pytest.raises(ValueError, match="config mismatch"):
+            check_config({"lr": 0.1}, {"lr": 0.2})
+
+    def test_config_ignore_progress_dim(self):
+        check_config({"lr": 0.1, "steps": 5}, {"lr": 0.1, "steps": 50},
+                     ignore=("steps",))
+
+    def test_atomic_no_partial_file(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, step=1)
+        save_checkpoint(p, step=2)
+        assert load_checkpoint(p)["step"] == 2
+        assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+@pytest.fixture(scope="module")
+def train_data():
+    return make_gaussians(128, 128, dim=4, separation=1.0, seed=0)
+
+
+class TestTrainerResume:
+    CFG = TrainConfig(kernel="logistic", lr=0.2, steps=12,
+                      n_workers=2, repartition_every=5, tile=32)
+
+    def _straight(self, train_data):
+        Xp, Xn = train_data
+        scorer = LinearScorer(dim=4)
+        return train_pairwise(scorer, scorer.init(0), Xp, Xn, self.CFG)
+
+    def test_chunked_equals_straight(self, train_data, tmp_path):
+        Xp, Xn = train_data
+        scorer = LinearScorer(dim=4)
+        ref_params, ref_hist = self._straight(train_data)
+        params, hist = train_pairwise(
+            scorer, scorer.init(0), Xp, Xn, self.CFG,
+            checkpoint_path=str(tmp_path / "t.npz"), checkpoint_every=5,
+        )
+        for k in ref_params:
+            np.testing.assert_array_equal(params[k], ref_params[k])
+        np.testing.assert_array_equal(hist["loss"], ref_hist["loss"])
+
+    def test_interrupt_and_resume(self, train_data, tmp_path):
+        """Train 7 of 12 steps, 'crash', resume to 12 — bit-identical
+        to the straight 12-step run."""
+        Xp, Xn = train_data
+        scorer = LinearScorer(dim=4)
+        p = str(tmp_path / "t.npz")
+        short = dataclasses.replace(self.CFG, steps=7)
+        train_pairwise(scorer, scorer.init(0), Xp, Xn, short,
+                       checkpoint_path=p)
+        params, hist = train_pairwise(
+            scorer, scorer.init(0), Xp, Xn, self.CFG, checkpoint_path=p,
+        )
+        ref_params, ref_hist = self._straight(train_data)
+        for k in ref_params:
+            np.testing.assert_array_equal(params[k], ref_params[k])
+        np.testing.assert_array_equal(hist["loss"], ref_hist["loss"])
+        assert len(hist["loss"]) == 12
+
+    def test_resume_rejects_other_config(self, train_data, tmp_path):
+        Xp, Xn = train_data
+        scorer = LinearScorer(dim=4)
+        p = str(tmp_path / "t.npz")
+        train_pairwise(scorer, scorer.init(0), Xp, Xn, self.CFG,
+                       checkpoint_path=p)
+        other = dataclasses.replace(self.CFG, lr=0.9)
+        with pytest.raises(ValueError, match="config mismatch"):
+            train_pairwise(scorer, scorer.init(0), Xp, Xn, other,
+                           checkpoint_path=p)
+
+    def test_shrunk_steps_raises(self, train_data, tmp_path):
+        """Params can't be rewound: resuming with fewer steps than the
+        checkpoint has trained must refuse, not mislabel the model."""
+        Xp, Xn = train_data
+        scorer = LinearScorer(dim=4)
+        p = str(tmp_path / "t.npz")
+        train_pairwise(scorer, scorer.init(0), Xp, Xn, self.CFG,
+                       checkpoint_path=p)
+        short = dataclasses.replace(self.CFG, steps=5)
+        with pytest.raises(ValueError, match="past the requested"):
+            train_pairwise(scorer, scorer.init(0), Xp, Xn, short,
+                           checkpoint_path=p)
+
+    def test_2d_mesh_trains(self, train_data):
+        """The trainer generalizes to 2-D (dcn x ici) meshes: same data
+        coverage as the 1-D mesh of equal size, loss decreasing."""
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        from tuplewise_tpu.parallel.mesh import make_mesh_2d
+
+        Xp, Xn = train_data
+        scorer = LinearScorer(dim=4)
+        cfg = dataclasses.replace(self.CFG, n_workers=8)
+        params, hist = train_pairwise(
+            scorer, scorer.init(0), Xp, Xn, cfg, mesh=make_mesh_2d(2, 4))
+        assert np.isfinite(hist["loss"]).all()
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_already_done_returns_saved(self, train_data, tmp_path):
+        Xp, Xn = train_data
+        scorer = LinearScorer(dim=4)
+        p = str(tmp_path / "t.npz")
+        ref_params, _ = train_pairwise(
+            scorer, scorer.init(0), Xp, Xn, self.CFG, checkpoint_path=p)
+        fresh = scorer.init(1)  # would train differently if rerun
+        params, hist = train_pairwise(
+            scorer, fresh, Xp, Xn, self.CFG, checkpoint_path=p)
+        for k in ref_params:
+            np.testing.assert_array_equal(params[k], ref_params[k])
+
+
+class TestHarnessResume:
+    CFG = VarianceConfig(kernel="auc", scheme="incomplete", backend="jax",
+                         n_pos=256, n_neg=256, n_pairs=500, n_reps=9,
+                         seed=3)
+
+    def test_interrupt_and_resume_vmapped(self, tmp_path):
+        p = str(tmp_path / "v.npz")
+        short = dataclasses.replace(self.CFG, n_reps=6)
+        run_variance_experiment(short, checkpoint_path=p,
+                                checkpoint_every=4)
+        res = run_variance_experiment(self.CFG, checkpoint_path=p,
+                                      checkpoint_every=4)
+        ref = run_variance_experiment(self.CFG)
+        assert res["mean"] == pytest.approx(ref["mean"], abs=1e-12)
+        assert res["variance"] == pytest.approx(ref["variance"], abs=1e-12)
+        assert res["n_reps"] == 9
+
+    def test_interrupt_and_resume_looped(self, tmp_path):
+        p = str(tmp_path / "l.npz")
+        cfg = dataclasses.replace(self.CFG, backend="numpy", n_reps=5)
+        short = dataclasses.replace(cfg, n_reps=3)
+        run_variance_experiment(short, checkpoint_path=p,
+                                checkpoint_every=2)
+        res = run_variance_experiment(cfg, checkpoint_path=p,
+                                      checkpoint_every=2)
+        ref = run_variance_experiment(cfg)
+        assert res["mean"] == pytest.approx(ref["mean"], abs=1e-12)
+        assert res["variance"] == pytest.approx(ref["variance"], abs=1e-12)
+
+    def test_resume_rejects_other_config(self, tmp_path):
+        p = str(tmp_path / "v.npz")
+        run_variance_experiment(
+            dataclasses.replace(self.CFG, n_reps=3), checkpoint_path=p)
+        with pytest.raises(ValueError, match="config mismatch"):
+            run_variance_experiment(
+                dataclasses.replace(self.CFG, separation=2.0),
+                checkpoint_path=p)
+
+    def test_shrunk_reps_raises(self, tmp_path):
+        """Fewer reps than checkpointed: the accumulated wallclock would
+        no longer describe the truncated estimates — refuse."""
+        p = str(tmp_path / "v.npz")
+        run_variance_experiment(self.CFG, checkpoint_path=p)
+        short = dataclasses.replace(self.CFG, n_reps=4)
+        with pytest.raises(ValueError, match="past the requested"):
+            run_variance_experiment(short, checkpoint_path=p)

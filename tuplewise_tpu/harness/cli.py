@@ -61,6 +61,9 @@ def main(argv=None) -> int:
         p = sub.add_parser(name)
         _add_variance_args(p)
         p.add_argument("--out", type=str, default=None)
+        if name == "variance":
+            p.add_argument("--checkpoint", type=str, default=None)
+            p.add_argument("--checkpoint-every", type=int, default=None)
         if name == "tradeoff-rounds":
             p.add_argument("--rounds", type=int, nargs="+",
                            default=[1, 2, 4, 8, 16])
@@ -88,11 +91,20 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--n", type=int, default=8000)
     p.add_argument("--out", type=str, default=None)
+    p.add_argument("--checkpoint", type=str, default=None)
+    p.add_argument("--checkpoint-every", type=int, default=None)
 
     args = ap.parse_args(argv)
 
     if args.cmd == "variance":
-        _emit(run_variance_experiment(_cfg_from_args(args)), args.out)
+        _emit(
+            run_variance_experiment(
+                _cfg_from_args(args),
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+            ),
+            args.out,
+        )
     elif args.cmd == "tradeoff-rounds":
         _emit(tradeoff_vs_rounds(_cfg_from_args(args), args.rounds), args.out)
     elif args.cmd == "tradeoff-pairs":
@@ -135,7 +147,11 @@ def main(argv=None) -> int:
             repartition_every=args.repartition_every,
             pairs_per_worker=args.pairs_per_worker, seed=args.seed,
         )
-        params, hist = train_pairwise(scorer, p0, Xp, Xn, cfg)
+        params, hist = train_pairwise(
+            scorer, p0, Xp, Xn, cfg,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+        )
         _emit(
             {
                 "config": dataclasses.asdict(cfg),

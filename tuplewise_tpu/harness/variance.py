@@ -74,13 +74,13 @@ def _estimate_once(est: Estimator, cfg: VarianceConfig, rep: int) -> float:
     raise ValueError(f"unknown scheme {cfg.scheme!r}")
 
 
-def _vmapped_jax_experiment(cfg: VarianceConfig) -> Optional[np.ndarray]:
-    """One-XLA-program Monte-Carlo for diff kernels on Gaussian scores.
-
-    Returns (estimates, compute_wallclock_s) — compiled in a warm-up call
-    so the wallclock is pure compute — or None if this config isn't
-    vmappable (feature kernels, non-jax backends, mesh execution).
-    """
+def _make_vmapped_runner(cfg: VarianceConfig):
+    """Compiled rep-array -> estimate-array runner for diff kernels on
+    Gaussian scores (one XLA program for the whole Monte-Carlo batch),
+    or None if this config isn't vmappable (feature kernels, non-jax
+    backends, mesh execution). Estimates depend only on the ABSOLUTE rep
+    indices passed in, so callers may chunk the rep range freely
+    (checkpoint/resume) without changing any value."""
     if cfg.backend != "jax" or get_kernel(cfg.kernel).kind != "diff":
         return None
 
@@ -137,39 +137,102 @@ def _vmapped_jax_experiment(cfg: VarianceConfig) -> Optional[np.ndarray]:
             )
         raise ValueError(cfg.scheme)
 
-    run = jax.jit(jax.vmap(one_rep))
-    reps = jnp.arange(cfg.n_reps)
-    np.asarray(run(reps))  # warm-up: compile outside the timing window
-    t0 = time.perf_counter()
-    estimates = np.asarray(run(reps))  # forced to host = synced
-    return estimates, time.perf_counter() - t0
+    return jax.jit(jax.vmap(one_rep))
 
 
 _SCHEMES = ("complete", "local", "repartitioned", "incomplete")
 
 
-def run_variance_experiment(cfg: VarianceConfig) -> dict:
+def run_variance_experiment(
+    cfg: VarianceConfig,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
+) -> dict:
     """M-rep Monte-Carlo [SURVEY §4.5]. Returns a JSON-serializable dict
-    with mean, empirical variance, wall-clock, and the config."""
+    with mean, empirical variance, wall-clock, and the config.
+
+    Checkpoint/resume [SURVEY §5.5]: with ``checkpoint_path``, reps run
+    in chunks of ``checkpoint_every`` and partial estimates persist after
+    each chunk; an existing checkpoint resumes from its saved rep count
+    (cfg.n_reps may grow across resumes; every other field must match).
+    Per-rep estimates are keyed by absolute rep index, so chunked and
+    straight runs produce identical estimate arrays. Accumulated compute
+    wall-clock carries across resumes.
+    """
     if cfg.scheme not in _SCHEMES:
         raise ValueError(
             f"unknown scheme {cfg.scheme!r}; choose one of {_SCHEMES}"
         )
-    vmapped_out = _vmapped_jax_experiment(cfg)
-    vmapped = vmapped_out is not None
+
+    from tuplewise_tpu.utils.checkpoint import (
+        check_config, load_checkpoint, save_checkpoint,
+    )
+
+    start, est_parts, wallclock = 0, [], 0.0
+    if checkpoint_path:
+        ck = load_checkpoint(checkpoint_path)
+        if ck is not None:
+            check_config(
+                ck["config"], cfg.to_json(), ignore=("n_reps",)
+            )
+            start = ck["step"]
+            if start > cfg.n_reps:
+                # truncating estimates while keeping the accumulated
+                # wallclock would distort the variance-vs-wallclock point
+                raise ValueError(
+                    f"checkpoint holds {start} reps, past the requested "
+                    f"n_reps={cfg.n_reps}; delete {checkpoint_path!r} to "
+                    "start fresh"
+                )
+            est_parts = [ck["extra"]["estimates"]]
+            wallclock = float(ck["extra"]["wallclock_s"])
+    every = checkpoint_every or max(cfg.n_reps - start, 1)
+
+    runner = _make_vmapped_runner(cfg)
+    vmapped = runner is not None
     if vmapped:
-        # compile happened in a warm-up call: wallclock is compute only,
-        # which is what the variance-vs-wallclock trade-off figure needs
-        estimates, wallclock = vmapped_out
+        import jax.numpy as jnp
+
+        warmed = set()
+
+        def run_chunk(m, chunk):
+            reps = jnp.arange(m, m + chunk)
+            if chunk not in warmed:
+                # compile outside the timing window: wallclock stays
+                # compute-only, which the variance-vs-wallclock trade-off
+                # figure needs
+                np.asarray(runner(reps))
+                warmed.add(chunk)
+            return lambda: np.asarray(runner(reps))  # host copy = synced
     else:
         est = Estimator(
             cfg.kernel, backend=cfg.backend, n_workers=cfg.n_workers
         )
+
+        def run_chunk(m, chunk):
+            return lambda: np.asarray([
+                _estimate_once(est, cfg, r) for r in range(m, m + chunk)
+            ])
+
+    m = start
+    while m < cfg.n_reps:
+        chunk = min(every, cfg.n_reps - m)
+        timed = run_chunk(m, chunk)  # warm-up outside the window
         t0 = time.perf_counter()
-        estimates = np.asarray(
-            [_estimate_once(est, cfg, m) for m in range(cfg.n_reps)]
-        )
-        wallclock = time.perf_counter() - t0
+        est_parts.append(timed())
+        wallclock += time.perf_counter() - t0
+        m += chunk
+        if checkpoint_path:
+            save_checkpoint(
+                checkpoint_path,
+                step=m,
+                extra={
+                    "estimates": np.concatenate(est_parts),
+                    "wallclock_s": np.asarray(wallclock),
+                },
+                config=cfg.to_json(),
+            )
+    estimates = np.concatenate(est_parts) if est_parts else np.empty(0)
     result = {
         "config": cfg.to_json(),
         "mean": float(np.mean(estimates)),

@@ -39,7 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tuplewise_tpu.models.metrics import auc_score
 from tuplewise_tpu.ops import pair_tiles
 from tuplewise_tpu.ops.kernels import get_kernel
-from tuplewise_tpu.parallel.mesh import make_mesh, shard_axis_name as AX
+from tuplewise_tpu.parallel.mesh import make_mesh
 from tuplewise_tpu.utils.rng import fold, root_key
 
 
@@ -69,12 +69,22 @@ def train_pairwise(
     X_neg: np.ndarray,
     cfg: TrainConfig,
     mesh=None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
 ):
     """Distributed pairwise SGD over a device mesh.
 
     Returns (params, history) where history["loss"] is the per-step
     psum-averaged surrogate loss. Runs on any mesh size >= 1 (a 1-chip
     mesh reproduces serial SGD over the full pair set).
+
+    Checkpoint/resume [SURVEY §5.5]: with ``checkpoint_path``, training
+    runs in scan chunks of ``checkpoint_every`` steps (default: one
+    chunk) and saves params + loss history after each; an existing
+    checkpoint resumes from its saved step. Resume is EXACT: every key
+    is folded from the absolute step index, so a chunked run reproduces
+    the unchunked run bit-for-bit (cfg.steps may differ across resumes;
+    every other config field must match).
     """
     kernel = get_kernel(cfg.kernel)
     if kernel.kind != "diff":
@@ -90,7 +100,10 @@ def train_pairwise(
         )
     mesh = mesh if mesh is not None else make_mesh(cfg.n_workers)
     N = int(np.prod(mesh.devices.shape))
-    shard_blocks = NamedSharding(mesh, P(AX))
+    # all mesh axes together form the worker axis (1-D or 2-D dcn x ici
+    # meshes alike) — same generalization as MeshBackend
+    axes = tuple(mesh.axis_names)
+    shard_blocks = NamedSharding(mesh, P(axes))
     replicated = NamedSharding(mesh, P())
 
     from tuplewise_tpu.parallel.device_partition import draw_blocks as _draw
@@ -121,7 +134,9 @@ def train_pairwise(
                 return pair_tiles.pair_mean(
                     kernel, s1, s2, tile_a=cfg.tile, tile_b=cfg.tile
                 )
-            shard = lax.axis_index(AX)
+            shard = lax.axis_index(axes[0])
+            for ax in axes[1:]:
+                shard = shard * lax.axis_size(ax) + lax.axis_index(ax)
             kk = fold(key, "pair_sample", shard)
             i, j = pair_tiles.sample_pair_indices(
                 kk, m1, m2, cfg.pairs_per_worker, one_sample=False
@@ -129,8 +144,8 @@ def train_pairwise(
             return jnp.mean(kernel.diff(s1[i] - s2[j], jnp))
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads = jax.tree.map(lambda g: lax.pmean(g, AX), grads)
-        loss = lax.pmean(loss, AX)
+        grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)
+        loss = lax.pmean(loss, axes)
         new_params = jax.tree.map(
             lambda p, g: p - cfg.lr * g, params, grads
         )
@@ -139,7 +154,7 @@ def train_pairwise(
     sgd_smap = jax.shard_map(
         sgd_body,
         mesh=mesh,
-        in_specs=(P(), P(AX), P(AX), P()),
+        in_specs=(P(), P(axes), P(axes), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -170,21 +185,75 @@ def train_pairwise(
         params, loss = sgd_smap(params, Ab, Bb, kt)
         return (params, Ab, Bb), loss
 
-    @jax.jit
-    def run(params):
-        k0 = fold(root, "repartition", 0)
-        k1, k2 = jax.random.split(k0)
+    def chunk_fn(params, t0, chunk_len):
+        """Steps [t0, t0 + chunk_len). Blocks are regathered as of the
+        most recent repartition boundary r0 = t0 - t0 % n_r with the key
+        folded from r0, so any chunking reproduces the unchunked run."""
+        r0 = t0 - t0 % cfg.repartition_every
+        kr = fold(root, "repartition", r0)
+        k1, k2 = jax.random.split(kr)
         Ab = Xp.at[draw_blocks(k1, n1, m1)].get(out_sharding=shard_blocks)
         Bb = Xn.at[draw_blocks(k2, n2, m2)].get(out_sharding=shard_blocks)
         (params, _, _), losses = lax.scan(
-            step_fn, (params, Ab, Bb), jnp.arange(cfg.steps)
+            step_fn, (params, Ab, Bb), t0 + jnp.arange(chunk_len)
         )
         return params, losses
 
-    params, losses = run(params)
+    run_chunk = jax.jit(chunk_fn, static_argnums=2)
+
+    # ---- checkpoint/resume plumbing [SURVEY §5.5] -------------------- #
+    from tuplewise_tpu.utils.checkpoint import (
+        check_config, load_checkpoint, save_checkpoint,
+    )
+
+    start, loss_parts = 0, []
+    if checkpoint_path:
+        ck = load_checkpoint(checkpoint_path)
+        if ck is not None:
+            check_config(
+                ck["config"], dataclasses.asdict(cfg), ignore=("steps",)
+            )
+            start = ck["step"]
+            if start > cfg.steps:
+                # params cannot be rewound; returning step-`start` params
+                # labeled as a `cfg.steps` run would be silently wrong
+                raise ValueError(
+                    f"checkpoint at step {start} is past the requested "
+                    f"steps={cfg.steps}; delete {checkpoint_path!r} to "
+                    "retrain from scratch"
+                )
+            loss_parts = [ck["extra"]["loss"]]
+            params = jax.device_put(
+                {k: jnp.asarray(v, jnp.float32)
+                 for k, v in ck["params"].items()},
+                replicated,
+            )
+            if start == cfg.steps:
+                return (
+                    jax.tree.map(np.asarray, params),
+                    {"loss": np.concatenate(loss_parts)},
+                )
+    every = checkpoint_every or (cfg.steps - start)
+    if every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {every}")
+
+    t = start
+    while t < cfg.steps:
+        chunk = min(every, cfg.steps - t)
+        params, losses = run_chunk(params, jnp.asarray(t, jnp.int32), chunk)
+        loss_parts.append(np.asarray(losses))
+        t += chunk
+        if checkpoint_path:
+            save_checkpoint(
+                checkpoint_path,
+                step=t,
+                params=jax.tree.map(np.asarray, params),
+                extra={"loss": np.concatenate(loss_parts)},
+                config=dataclasses.asdict(cfg),
+            )
     return (
         jax.tree.map(np.asarray, params),
-        {"loss": np.asarray(losses)},
+        {"loss": np.concatenate(loss_parts)},
     )
 
 

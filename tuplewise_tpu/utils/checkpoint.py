@@ -1,0 +1,99 @@
+"""Checkpoint/resume for the learner and the Monte-Carlo harness
+[SURVEY §5.5].
+
+Single-file ``.npz`` checkpoints, written atomically (tmp + rename):
+
+* ``step``          — how far the run has progressed (SGD steps or
+                      Monte-Carlo reps);
+* ``param/<name>``  — model parameter arrays (learner);
+* ``extra/<name>``  — partial result arrays (loss curves, estimates);
+* ``config``        — the run config as a JSON string; on resume the
+                      stored config must match the requested one (the
+                      progress dimension — steps/reps — excluded), so a
+                      checkpoint can never silently continue a different
+                      experiment.
+
+Resume is EXACT for both consumers because every source of randomness is
+keyed by absolute step/rep index via utils.rng.fold (never by "time since
+start"): a run chunked at any boundary reproduces the unchunked run
+bit-for-bit. tests/test_checkpoint.py asserts this equivalence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def save_checkpoint(
+    path: str,
+    *,
+    step: int,
+    params: Optional[Dict[str, Any]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+    config: Optional[dict] = None,
+) -> None:
+    """Atomically write a checkpoint (tmp file + os.replace)."""
+    blob: Dict[str, Any] = {"step": np.asarray(int(step))}
+    for name, arr in (params or {}).items():
+        blob[f"param/{name}"] = np.asarray(arr)
+    for name, arr in (extra or {}).items():
+        blob[f"extra/{name}"] = np.asarray(arr)
+    if config is not None:
+        blob["config"] = np.asarray(json.dumps(config, sort_keys=True))
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **blob)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_checkpoint(path: str) -> Optional[dict]:
+    """Load a checkpoint, or None if ``path`` doesn't exist.
+
+    Returns {"step": int, "params": {...}, "extra": {...}, "config": dict|None}.
+    """
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as blob:
+        out = {"step": int(blob["step"]), "params": {}, "extra": {},
+               "config": None}
+        for key in blob.files:
+            if key.startswith("param/"):
+                out["params"][key[len("param/"):]] = blob[key]
+            elif key.startswith("extra/"):
+                out["extra"][key[len("extra/"):]] = blob[key]
+            elif key == "config":
+                out["config"] = json.loads(str(blob[key]))
+    return out
+
+
+def check_config(
+    stored: Optional[dict], requested: dict, *, ignore: tuple = ()
+) -> None:
+    """Raise if a checkpoint's config doesn't match the requested run
+    (modulo ``ignore`` — the progress dimensions like steps/n_reps)."""
+    if stored is None:
+        return
+    a = {k: v for k, v in stored.items() if k not in ignore}
+    b = {k: v for k, v in requested.items() if k not in ignore}
+    if a != b:
+        diff = {
+            k: (a.get(k), b.get(k))
+            for k in sorted(set(a) | set(b))
+            if a.get(k) != b.get(k)
+        }
+        raise ValueError(
+            f"checkpoint config mismatch (stored vs requested): {diff}; "
+            "refusing to resume a different experiment — delete the "
+            "checkpoint file to start fresh"
+        )
