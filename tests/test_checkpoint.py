@@ -193,6 +193,13 @@ class TestHarnessResume:
                 dataclasses.replace(self.CFG, separation=2.0),
                 checkpoint_path=p)
 
+    def test_negative_checkpoint_every_raises(self, tmp_path):
+        """Regression: a negative chunk size used to loop forever."""
+        with pytest.raises(ValueError, match="checkpoint_every"):
+            run_variance_experiment(
+                self.CFG, checkpoint_path=str(tmp_path / "v.npz"),
+                checkpoint_every=-2)
+
     def test_shrunk_reps_raises(self, tmp_path):
         """Fewer reps than checkpointed: the accumulated wallclock would
         no longer describe the truncated estimates — refuse."""

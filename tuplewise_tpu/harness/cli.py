@@ -64,6 +64,8 @@ def main(argv=None) -> int:
         if name == "variance":
             p.add_argument("--checkpoint", type=str, default=None)
             p.add_argument("--checkpoint-every", type=int, default=None)
+            p.add_argument("--trace-dir", type=str, default=None,
+                           help="write a jax.profiler trace here")
         if name == "tradeoff-rounds":
             p.add_argument("--rounds", type=int, nargs="+",
                            default=[1, 2, 4, 8, 16])
@@ -102,6 +104,7 @@ def main(argv=None) -> int:
                 _cfg_from_args(args),
                 checkpoint_path=args.checkpoint,
                 checkpoint_every=args.checkpoint_every,
+                trace_dir=args.trace_dir,
             ),
             args.out,
         )

@@ -147,6 +147,7 @@ def run_variance_experiment(
     cfg: VarianceConfig,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: Optional[int] = None,
+    trace_dir: Optional[str] = None,
 ) -> dict:
     """M-rep Monte-Carlo [SURVEY §4.5]. Returns a JSON-serializable dict
     with mean, empirical variance, wall-clock, and the config.
@@ -165,28 +166,17 @@ def run_variance_experiment(
         )
 
     from tuplewise_tpu.utils.checkpoint import (
-        check_config, load_checkpoint, save_checkpoint,
+        iter_chunks, resume_progress, save_checkpoint,
     )
 
-    start, est_parts, wallclock = 0, [], 0.0
-    if checkpoint_path:
-        ck = load_checkpoint(checkpoint_path)
-        if ck is not None:
-            check_config(
-                ck["config"], cfg.to_json(), ignore=("n_reps",)
-            )
-            start = ck["step"]
-            if start > cfg.n_reps:
-                # truncating estimates while keeping the accumulated
-                # wallclock would distort the variance-vs-wallclock point
-                raise ValueError(
-                    f"checkpoint holds {start} reps, past the requested "
-                    f"n_reps={cfg.n_reps}; delete {checkpoint_path!r} to "
-                    "start fresh"
-                )
-            est_parts = [ck["extra"]["estimates"]]
-            wallclock = float(ck["extra"]["wallclock_s"])
-    every = checkpoint_every or max(cfg.n_reps - start, 1)
+    start, ck = resume_progress(
+        checkpoint_path, cfg.to_json(),
+        progress_key="n_reps", requested=cfg.n_reps,
+    )
+    est_parts, wallclock = [], 0.0
+    if ck is not None:
+        est_parts = [ck["extra"]["estimates"]]
+        wallclock = float(ck["extra"]["wallclock_s"])
 
     runner = _make_vmapped_runner(cfg)
     vmapped = runner is not None
@@ -214,24 +204,24 @@ def run_variance_experiment(
                 _estimate_once(est, cfg, r) for r in range(m, m + chunk)
             ])
 
-    m = start
-    while m < cfg.n_reps:
-        chunk = min(every, cfg.n_reps - m)
-        timed = run_chunk(m, chunk)  # warm-up outside the window
-        t0 = time.perf_counter()
-        est_parts.append(timed())
-        wallclock += time.perf_counter() - t0
-        m += chunk
-        if checkpoint_path:
-            save_checkpoint(
-                checkpoint_path,
-                step=m,
-                extra={
-                    "estimates": np.concatenate(est_parts),
-                    "wallclock_s": np.asarray(wallclock),
-                },
-                config=cfg.to_json(),
-            )
+    from tuplewise_tpu.utils.profiling import trace
+
+    with trace(trace_dir):  # jax.profiler scope when requested [§5.2]
+        for m, chunk in iter_chunks(start, cfg.n_reps, checkpoint_every):
+            timed = run_chunk(m, chunk)  # warm-up outside the window
+            t0 = time.perf_counter()
+            est_parts.append(timed())
+            wallclock += time.perf_counter() - t0
+            if checkpoint_path:
+                save_checkpoint(
+                    checkpoint_path,
+                    step=m + chunk,
+                    extra={
+                        "estimates": np.concatenate(est_parts),
+                        "wallclock_s": np.asarray(wallclock),
+                    },
+                    config=cfg.to_json(),
+                )
     estimates = np.concatenate(est_parts) if est_parts else np.empty(0)
     result = {
         "config": cfg.to_json(),
@@ -242,6 +232,8 @@ def run_variance_experiment(
         "vmapped": vmapped,
         "n_reps": cfg.n_reps,
     }
+    if trace_dir:
+        result["trace_dir"] = trace_dir
     if cfg.kernel == "auc" and cfg.dim == 1:
         result["population_value"] = true_gaussian_auc(cfg.separation)
     return result

@@ -161,7 +161,7 @@ def train_pairwise(
 
     root = root_key(cfg.seed)
 
-    def step_fn(carry, t):
+    def step_fn(carry, t, t0):
         params, Ab, Bb = carry
         kt = fold(root, "step", t)
 
@@ -175,11 +175,11 @@ def train_pairwise(
                 Xn.at[i2].get(out_sharding=shard_blocks),
             )
 
-        # t=0's blocks are drawn outside the scan with the same key, so
-        # only refresh on later repartition boundaries (one startup
-        # regather, not two)
+        # the chunk's first blocks (incl. a boundary-aligned t0) are
+        # drawn by chunk_fn with the same key, so only refresh on LATER
+        # boundaries — one startup regather per chunk, not two
         Ab, Bb = lax.cond(
-            (t % cfg.repartition_every == 0) & (t > 0),
+            (t % cfg.repartition_every == 0) & (t > t0),
             refresh, lambda _: (Ab, Bb), None,
         )
         params, loss = sgd_smap(params, Ab, Bb, kt)
@@ -195,7 +195,8 @@ def train_pairwise(
         Ab = Xp.at[draw_blocks(k1, n1, m1)].get(out_sharding=shard_blocks)
         Bb = Xn.at[draw_blocks(k2, n2, m2)].get(out_sharding=shard_blocks)
         (params, _, _), losses = lax.scan(
-            step_fn, (params, Ab, Bb), t0 + jnp.arange(chunk_len)
+            functools.partial(step_fn, t0=t0),
+            (params, Ab, Bb), t0 + jnp.arange(chunk_len)
         )
         return params, losses
 
@@ -203,50 +204,34 @@ def train_pairwise(
 
     # ---- checkpoint/resume plumbing [SURVEY §5.5] -------------------- #
     from tuplewise_tpu.utils.checkpoint import (
-        check_config, load_checkpoint, save_checkpoint,
+        iter_chunks, resume_progress, save_checkpoint,
     )
 
-    start, loss_parts = 0, []
-    if checkpoint_path:
-        ck = load_checkpoint(checkpoint_path)
-        if ck is not None:
-            check_config(
-                ck["config"], dataclasses.asdict(cfg), ignore=("steps",)
+    start, ck = resume_progress(
+        checkpoint_path, dataclasses.asdict(cfg),
+        progress_key="steps", requested=cfg.steps,
+    )
+    loss_parts = []
+    if ck is not None:
+        loss_parts = [ck["extra"]["loss"]]
+        params = jax.device_put(
+            {k: jnp.asarray(v, jnp.float32)
+             for k, v in ck["params"].items()},
+            replicated,
+        )
+        if start == cfg.steps:
+            return (
+                jax.tree.map(np.asarray, params),
+                {"loss": np.concatenate(loss_parts)},
             )
-            start = ck["step"]
-            if start > cfg.steps:
-                # params cannot be rewound; returning step-`start` params
-                # labeled as a `cfg.steps` run would be silently wrong
-                raise ValueError(
-                    f"checkpoint at step {start} is past the requested "
-                    f"steps={cfg.steps}; delete {checkpoint_path!r} to "
-                    "retrain from scratch"
-                )
-            loss_parts = [ck["extra"]["loss"]]
-            params = jax.device_put(
-                {k: jnp.asarray(v, jnp.float32)
-                 for k, v in ck["params"].items()},
-                replicated,
-            )
-            if start == cfg.steps:
-                return (
-                    jax.tree.map(np.asarray, params),
-                    {"loss": np.concatenate(loss_parts)},
-                )
-    every = checkpoint_every or (cfg.steps - start)
-    if every < 1:
-        raise ValueError(f"checkpoint_every must be >= 1, got {every}")
 
-    t = start
-    while t < cfg.steps:
-        chunk = min(every, cfg.steps - t)
+    for t, chunk in iter_chunks(start, cfg.steps, checkpoint_every):
         params, losses = run_chunk(params, jnp.asarray(t, jnp.int32), chunk)
         loss_parts.append(np.asarray(losses))
-        t += chunk
         if checkpoint_path:
             save_checkpoint(
                 checkpoint_path,
-                step=t,
+                step=t + chunk,
                 params=jax.tree.map(np.asarray, params),
                 extra={"loss": np.concatenate(loss_parts)},
                 config=dataclasses.asdict(cfg),

@@ -77,6 +77,50 @@ def load_checkpoint(path: str) -> Optional[dict]:
     return out
 
 
+def resume_progress(
+    path: Optional[str],
+    config: dict,
+    *,
+    progress_key: str,
+    requested: int,
+):
+    """Shared resume preamble for chunked runs (trainer + harness).
+
+    Returns (start, checkpoint-or-None). Validates the stored config
+    against ``config`` (ignoring ``progress_key``, the resumable
+    dimension) and refuses checkpoints whose progress exceeds the
+    request — progress cannot be rewound without producing results
+    mislabeled as a shorter run.
+    """
+    ck = load_checkpoint(path) if path else None
+    if ck is None:
+        return 0, None
+    check_config(ck["config"], config, ignore=(progress_key,))
+    start = ck["step"]
+    if start > requested:
+        raise ValueError(
+            f"checkpoint at {progress_key}={start} is past the requested "
+            f"{progress_key}={requested}; delete {path!r} to start fresh"
+        )
+    return start, ck
+
+
+def iter_chunks(start: int, total: int, every: Optional[int]):
+    """Yield (offset, length) chunk bounds covering [start, total).
+
+    ``every`` of None/0 means one chunk; negative values are rejected
+    (both consumers share this guard so they cannot diverge)."""
+    if not every:
+        every = max(total - start, 1)
+    if every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {every}")
+    m = start
+    while m < total:
+        c = min(every, total - m)
+        yield m, c
+        m += c
+
+
 def check_config(
     stored: Optional[dict], requested: dict, *, ignore: tuple = ()
 ) -> None:

@@ -1,0 +1,72 @@
+"""Tracing / profiling / observability helpers [SURVEY §5.2, §5.6].
+
+The reference has none of this (printed numbers + matplotlib); the build
+standardizes three small tools:
+
+* ``timer()``        — wall-clock context manager; the harness reports
+                       its numbers alongside every variance result
+                       (wall-clock is half the headline metric [B:2]).
+* ``trace(logdir)``  — ``jax.profiler`` trace scope (XLA host/device
+                       timeline, viewable in TensorBoard/Perfetto);
+                       no-op when logdir is None, so callers can thread
+                       a CLI flag straight through.
+* ``device_memory_stats()`` — per-device HBM usage snapshot where the
+                       backend exposes it (TPU does; CPU returns {}).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def timer() -> Iterator[dict]:
+    """``with timer() as t: ...`` then ``t["seconds"]``."""
+    out = {"seconds": None}
+    t0 = time.perf_counter()
+    try:
+        yield out
+    finally:
+        out["seconds"] = time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]) -> Iterator[None]:
+    """jax.profiler trace scope; inert when ``logdir`` is None.
+
+    The trace captures XLA compilation, host callbacks, and device
+    compute for everything executed inside the scope.
+    """
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(logdir)):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-span inside an active trace (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory_stats() -> dict:
+    """{device_str: memory_stats dict} for devices that report it."""
+    import jax
+
+    out = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[str(d)] = dict(stats)
+    return out
