@@ -7,9 +7,11 @@ incomplete, SURVEY §1.2) live above this boundary and are identical
 across backends:
 
 * ``numpy`` — the serial reference oracle (frozen semantics).
+* ``cpp``   — oracle semantics with the pair loop in compiled C++
+  (native/pair_sum.cpp via ctypes; OpenMP rows, deterministic fold).
 * ``jax``   — single-device XLA: tiled `lax` loops, `jax.random`.
-* ``mesh``  — multi-chip SPMD: `shard_map` over a 1-D mesh, `ppermute`
-  ring for cross-shard pairs, `psum` aggregation.
+* ``mesh``  — multi-chip SPMD: `shard_map` over a 1-D or 2-D mesh,
+  `ppermute` ring for cross-shard pairs, `psum` aggregation.
 
 Every backend implements the same four estimator entry points with the
 same statistical meaning, so oracle-parity tests are a for-loop over
@@ -32,6 +34,7 @@ def register_backend(name: str):
 
 _LAZY = {
     "numpy": "tuplewise_tpu.backends.numpy_backend",
+    "cpp": "tuplewise_tpu.backends.cpp_backend",
     "jax": "tuplewise_tpu.backends.jax_backend",
     "mesh": "tuplewise_tpu.backends.mesh_backend",
 }

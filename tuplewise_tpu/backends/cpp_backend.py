@@ -1,0 +1,93 @@
+"""Native C++ CPU backend — the accelerated host-side engine.
+
+Same estimator semantics as the frozen NumPy oracle (it *subclasses*
+NumpyBackend and swaps only the innermost pair reduction), with the hot
+loop running in the compiled ``native/pair_sum.cpp`` engine: -O3,
+OpenMP row parallelism, deterministic sequential Kahan fold. The oracle
+stays untouched [SURVEY §6 "self-baseline"]; this backend exists so the
+reference path itself has a serious native runtime, and as the fast
+host-side check for large-n parity runs.
+
+Falls back kernel-by-kernel: diff kernels (auc/hinge/logistic) and the
+scatter kernel dispatch to C++; anything else (triplets, user-registered
+Python kernels) runs the inherited NumPy path, so every kernel works.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tuplewise_tpu.backends.base import register_backend
+from tuplewise_tpu.backends.numpy_backend import NumpyBackend
+from tuplewise_tpu.ops.kernels import Kernel
+
+_DIFF_IDS = {"auc": 0, "hinge": 1, "logistic": 2}
+
+
+def _i64p(x: Optional[np.ndarray]):
+    if x is None:
+        return None
+    return x.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _dp(x: np.ndarray):
+    return x.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+@register_backend("cpp")
+class CppBackend(NumpyBackend):
+    """NumPy-oracle semantics with the pair loop in compiled C++."""
+
+    name = "cpp"
+
+    def __init__(self, kernel: Kernel, block_size: int = 4096):
+        super().__init__(kernel, block_size)
+        from tuplewise_tpu.native import load_pair_lib
+
+        self._lib = load_pair_lib()
+        if self._lib is None:
+            raise RuntimeError(
+                "native pair library unavailable (no working g++?); "
+                "use backend='numpy' instead"
+            )
+
+    # The ONLY override: the innermost (sum, count) pair reduction.
+    def _pair_stats(
+        self,
+        A: np.ndarray,
+        B: np.ndarray,
+        ids_a: Optional[np.ndarray] = None,
+        ids_b: Optional[np.ndarray] = None,
+    ) -> Tuple[float, int]:
+        k = self.kernel
+        use_ids = ids_a is not None
+        ia = None if not use_ids else np.ascontiguousarray(ids_a, np.int64)
+        ib = None if not use_ids else np.ascontiguousarray(ids_b, np.int64)
+        out_sum = ctypes.c_double()
+        out_count = ctypes.c_int64()
+
+        if k.kind == "diff" and k.name in _DIFF_IDS:
+            a = np.ascontiguousarray(A, np.float64)
+            b = np.ascontiguousarray(B, np.float64)
+            self._lib.pair_stats_diff(
+                _DIFF_IDS[k.name], _dp(a), len(a), _dp(b), len(b),
+                _i64p(ia), _i64p(ib), int(use_ids),
+                ctypes.byref(out_sum), ctypes.byref(out_count),
+            )
+            return out_sum.value, int(out_count.value)
+
+        if k.kind == "pair" and k.name == "scatter":
+            a = np.ascontiguousarray(np.atleast_2d(A), np.float64)
+            b = np.ascontiguousarray(np.atleast_2d(B), np.float64)
+            self._lib.pair_stats_scatter(
+                _dp(a), a.shape[0], _dp(b), b.shape[0], a.shape[1],
+                _i64p(ia), _i64p(ib), int(use_ids),
+                ctypes.byref(out_sum), ctypes.byref(out_count),
+            )
+            return out_sum.value, int(out_count.value)
+
+        # unknown/custom kernels: inherited pure-NumPy blockwise path
+        return super()._pair_stats(A, B, ids_a, ids_b)

@@ -204,14 +204,14 @@ def run_variance_experiment(
                 _estimate_once(est, cfg, r) for r in range(m, m + chunk)
             ])
 
-    from tuplewise_tpu.utils.profiling import trace
+    from tuplewise_tpu.utils.profiling import timer, trace
 
     with trace(trace_dir):  # jax.profiler scope when requested [§5.2]
         for m, chunk in iter_chunks(start, cfg.n_reps, checkpoint_every):
             timed = run_chunk(m, chunk)  # warm-up outside the window
-            t0 = time.perf_counter()
-            est_parts.append(timed())
-            wallclock += time.perf_counter() - t0
+            with timer() as t:
+                est_parts.append(timed())
+            wallclock += t["seconds"]
             if checkpoint_path:
                 save_checkpoint(
                     checkpoint_path,

@@ -1,0 +1,149 @@
+// Native pair-kernel reduction engine for the CPU backend family.
+//
+// The TPU compute path is JAX/XLA/Pallas (ops/); this C++ engine is the
+// native runtime for the host-side reference/serial path: the same
+// blockwise streaming reduction as backends/numpy_backend.py, compiled
+// with -O3 and parallelized over rows with OpenMP when available.
+//
+// Determinism: each row's inner reduction is sequential, per-row results
+// land in a row_sums array indexed by row, and the final fold over rows
+// is a sequential Kahan sum — so the result is independent of thread
+// scheduling and reproducible run-to-run.
+//
+// Kernel ids mirror ops/kernels.py exactly:
+//   0 = auc       g(d) = 1{d>0} + 0.5*1{d==0}
+//   1 = hinge     g(d) = max(0, 1 - d)
+//   2 = logistic  g(d) = log(1 + exp(-d))   (stable softplus)
+//
+// Exclusion semantics match NumpyBackend._pair_stats: when use_ids is
+// set, grid cells with ids_a[i] == ids_b[j] are skipped (one-sample
+// diagonal and with-replacement duplicates).
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+inline double softplus_neg(double d) {
+    // log(1 + exp(-d)), stable for any d
+    if (d > 0.0) {
+        return std::log1p(std::exp(-d));
+    }
+    return -d + std::log1p(std::exp(d));
+}
+
+inline double eval_diff(int kernel_id, double d) {
+    switch (kernel_id) {
+        case 0:  // auc indicator with half-weight ties
+            return d > 0.0 ? 1.0 : (d == 0.0 ? 0.5 : 0.0);
+        case 1:  // hinge
+            return d < 1.0 ? 1.0 - d : 0.0;
+        default:  // 2: logistic
+            return softplus_neg(d);
+    }
+}
+
+struct Acc {
+    double sum = 0.0;
+    int64_t count = 0;
+};
+
+// Sequential Kahan fold of per-row partials (deterministic).
+void fold_rows(const std::vector<Acc>& rows, double* out_sum,
+               int64_t* out_count) {
+    double s = 0.0, comp = 0.0;
+    int64_t c = 0;
+    for (const Acc& r : rows) {
+        double y = r.sum - comp;
+        double t = s + y;
+        comp = (t - s) - y;
+        s = t;
+        c += r.count;
+    }
+    *out_sum = s - comp;
+    *out_count = c;
+}
+
+}  // namespace
+
+extern "C" {
+
+// (sum, count) of g(a_i - b_j) over the (masked-by-ids) pair grid.
+void pair_stats_diff(int kernel_id, const double* a, int64_t n1,
+                     const double* b, int64_t n2, const int64_t* ids_a,
+                     const int64_t* ids_b, int use_ids, double* out_sum,
+                     int64_t* out_count) {
+    std::vector<Acc> rows(static_cast<size_t>(n1));
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n1; ++i) {
+        const double ai = a[i];
+        const int64_t ia = use_ids ? ids_a[i] : 0;
+        double s = 0.0, comp = 0.0;
+        int64_t c = 0;
+        for (int64_t j = 0; j < n2; ++j) {
+            if (use_ids && ia == ids_b[j]) continue;
+            const double v = eval_diff(kernel_id, ai - b[j]);
+            double y = v - comp;
+            double t = s + y;
+            comp = (t - s) - y;
+            s = t;
+            ++c;
+        }
+        rows[static_cast<size_t>(i)].sum = s - comp;
+        rows[static_cast<size_t>(i)].count = c;
+    }
+    fold_rows(rows, out_sum, out_count);
+}
+
+// (sum, count) of the scatter kernel h(x, x') = ||x - x'||^2 / 2 over
+// the [n1, n2] grid of d-dimensional rows, with id exclusion.
+void pair_stats_scatter(const double* a, int64_t n1, const double* b,
+                        int64_t n2, int64_t dim, const int64_t* ids_a,
+                        const int64_t* ids_b, int use_ids, double* out_sum,
+                        int64_t* out_count) {
+    std::vector<Acc> rows(static_cast<size_t>(n1));
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n1; ++i) {
+        const double* xi = a + i * dim;
+        const int64_t ia = use_ids ? ids_a[i] : 0;
+        double s = 0.0, comp = 0.0;
+        int64_t c = 0;
+        for (int64_t j = 0; j < n2; ++j) {
+            if (use_ids && ia == ids_b[j]) continue;
+            const double* yj = b + j * dim;
+            double d2 = 0.0;
+            for (int64_t k = 0; k < dim; ++k) {
+                const double diff = xi[k] - yj[k];
+                d2 += diff * diff;
+            }
+            const double v = 0.5 * d2;
+            double y = v - comp;
+            double t = s + y;
+            comp = (t - s) - y;
+            s = t;
+            ++c;
+        }
+        rows[static_cast<size_t>(i)].sum = s - comp;
+        rows[static_cast<size_t>(i)].count = c;
+    }
+    fold_rows(rows, out_sum, out_count);
+}
+
+int native_num_threads() {
+#ifdef _OPENMP
+    return omp_get_max_threads();
+#else
+    return 1;
+#endif
+}
+
+}  // extern "C"
