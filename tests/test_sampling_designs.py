@@ -1,0 +1,120 @@
+"""Incomplete-U sampling designs [SURVEY §1.1; PAPERS.md:6].
+
+swr (with replacement) / swor (distinct tuples) / bernoulli (independent
+inclusion). All three are unbiased for E[h]; swor carries the
+finite-population variance reduction, which is the testable signature.
+"""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu import Estimator
+from tuplewise_tpu.data import make_gaussians
+from tuplewise_tpu.parallel.partition import draw_pair_design
+
+
+class TestDrawPairDesign:
+    def test_swor_distinct(self):
+        rng = np.random.default_rng(0)
+        i, j = draw_pair_design(rng, 50, 40, 1500, "swor")
+        assert len(set(zip(i.tolist(), j.tolist()))) == 1500
+        assert i.min() >= 0 and i.max() < 50
+        assert j.min() >= 0 and j.max() < 40
+
+    def test_swor_huge_grid_dedup_path(self):
+        rng = np.random.default_rng(1)
+        i, j = draw_pair_design(rng, 10**6, 10**6, 5000, "swor")
+        assert len(set(zip(i.tolist(), j.tolist()))) == 5000
+
+    def test_swor_cannot_exceed_grid(self):
+        rng = np.random.default_rng(2)
+        with pytest.raises(ValueError, match="distinct"):
+            draw_pair_design(rng, 4, 4, 17, "swor")
+
+    def test_bernoulli_realized_size_binomial(self):
+        rng = np.random.default_rng(3)
+        sizes = [
+            len(draw_pair_design(rng, 100, 100, 2000, "bernoulli")[0])
+            for _ in range(50)
+        ]
+        # Binomial(10^4, 0.2): mean 2000, sd ~40
+        assert 1800 < np.mean(sizes) < 2200
+        assert np.std(sizes) > 1.0  # actually random, not fixed
+
+    def test_one_sample_off_diagonal(self):
+        rng = np.random.default_rng(4)
+        i, j = draw_pair_design(rng, 30, 29, 600, "swor", one_sample=True)
+        assert np.all(i != j)
+        assert len(set(zip(i.tolist(), j.tolist()))) == 600
+
+    def test_unknown_design(self):
+        with pytest.raises(ValueError, match="unknown sampling design"):
+            draw_pair_design(np.random.default_rng(0), 5, 5, 3, "systematic")
+
+
+@pytest.fixture(scope="module")
+def scores():
+    X, Y = make_gaussians(400, 400, dim=1, separation=1.0, seed=6)
+    return X[:, 0], Y[:, 0]
+
+
+class TestEstimatorDesigns:
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    @pytest.mark.parametrize("design", ["swor", "bernoulli"])
+    def test_unbiased(self, scores, backend, design):
+        s1, s2 = scores
+        u_n = Estimator("auc", backend="numpy").complete(s1, s2)
+        est = Estimator("auc", backend=backend)
+        vals = [
+            est.incomplete(s1, s2, n_pairs=4000, seed=m, design=design)
+            for m in range(40)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
+        assert abs(np.mean(vals) - u_n) < 5 * se
+
+    def test_one_sample_swor(self):
+        rng = np.random.default_rng(7)
+        A = rng.standard_normal((120, 3))
+        u_n = Estimator("scatter", backend="numpy").complete(A)
+        est = Estimator("scatter", backend="numpy")
+        vals = [
+            est.incomplete(A, n_pairs=3000, seed=m, design="swor")
+            for m in range(40)
+        ]
+        se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
+        assert abs(np.mean(vals) - u_n) < 5 * se
+
+    def test_swor_variance_reduction(self):
+        """B close to the grid size: SWOR variance must approach the
+        complete-U variance, far below SWR's extra Var(h)/B term."""
+        X, Y = make_gaussians(32, 32, dim=1, separation=1.0, seed=8)
+        s1, s2 = X[:, 0], Y[:, 0]
+        est = Estimator("auc", backend="numpy")
+        B = 32 * 32 - 64  # 93.75% of the grid
+        swor = [est.incomplete(s1, s2, n_pairs=B, seed=m, design="swor")
+                for m in range(300)]
+        swr = [est.incomplete(s1, s2, n_pairs=B, seed=m, design="swr")
+               for m in range(300)]
+        assert np.var(swor) < 0.6 * np.var(swr)
+
+    def test_mesh_rejects_non_swr(self, scores):
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        s1, s2 = scores
+        est = Estimator("auc", backend="mesh", n_workers=8)
+        with pytest.raises(ValueError, match="within shards"):
+            est.incomplete(s1, s2, n_pairs=100, design="swor")
+
+    def test_cpp_backend_inherits_designs(self, scores):
+        from tuplewise_tpu.native import load_pair_lib
+
+        if load_pair_lib() is None:
+            pytest.skip("no native lib")
+        s1, s2 = scores
+        a = Estimator("auc", backend="numpy").incomplete(
+            s1, s2, n_pairs=2000, seed=9, design="swor")
+        b = Estimator("auc", backend="cpp").incomplete(
+            s1, s2, n_pairs=2000, seed=9, design="swor")
+        assert a == pytest.approx(b, rel=1e-12)

@@ -189,6 +189,15 @@ class JaxBackend:
             incomplete_fn, static_argnames=("n_pairs",)
         )
 
+        def gather_mean_fn(A, B, i, j):
+            return jnp.mean(
+                k.pair_elementwise(A[i], B[j], jnp), dtype=A.dtype
+            )
+
+        # host-designed samples (swor/bernoulli): indices come from the
+        # shared NumPy sampler, only the kernel evaluation is on device
+        self._gather_mean = jax.jit(gather_mean_fn)
+
     # ------------------------------------------------------------------ #
     def _dev(self, A, B):
         A = jnp.asarray(A, self.dtype)
@@ -225,8 +234,34 @@ class JaxBackend:
             self._alive(n_workers, dropped_workers),
             n_workers=n_workers, n_rounds=n_rounds, scheme=scheme))
 
-    def incomplete(self, A, B=None, *, n_pairs, seed=0):
+    def incomplete(self, A, B=None, *, n_pairs, seed=0, design="swr"):
+        """B sampled tuples; design in {"swr", "swor", "bernoulli"}
+        [SURVEY §1.1 incomplete]. "swr" samples on device inside the
+        jitted program; the distinct-tuple designs draw indices with the
+        shared host sampler (parallel.partition.draw_pair_design) and
+        evaluate the kernel on device — index generation is O(B) host
+        work, the O(B) kernel math stays compiled. (bernoulli's realized
+        sample size varies, so each new size compiles once.)"""
         A, B = self._dev(A, B)
+        if design != "swr":
+            if self.kernel.kind == "triplet":
+                raise ValueError(
+                    "triplet incomplete sampling supports design='swr' "
+                    f"only, got {design!r}"
+                )
+            from tuplewise_tpu.parallel.partition import draw_pair_design
+
+            one_sample = not self.kernel.two_sample
+            Bv = A if B is None else B
+            n1 = A.shape[0]
+            n2 = n1 - 1 if one_sample else Bv.shape[0]
+            i, j = draw_pair_design(
+                np.random.default_rng(seed), n1, n2, n_pairs, design,
+                one_sample=one_sample,
+            )
+            return float(self._gather_mean(
+                A, A if one_sample else Bv,
+                jnp.asarray(i), jnp.asarray(j)))
         key = fold(root_key(seed), "incomplete")
         return float(self._incomplete(
             A, B if B is not None else A, key, n_pairs=n_pairs))

@@ -326,12 +326,18 @@ class MeshBackend:
             Ag, Bg, root_key(seed), self._alive(dropped_workers),
             n1=len(A), n2=len(B), n_rounds=n_rounds, scheme=scheme))
 
-    def incomplete(self, A, B=None, *, n_pairs, seed=0):
+    def incomplete(self, A, B=None, *, n_pairs, seed=0, design="swr"):
         """Within-shard sampling over a random packing [SURVEY §1.2.4].
 
         Each shard draws ceil(n_pairs / N) local tuples, so the total
         tuple budget is n_pairs rounded UP to a multiple of N (never
         under-samples the requested B)."""
+        if design != "swr":
+            raise ValueError(
+                "the mesh backend samples within shards with replacement "
+                f"(design='swr'); got {design!r} — use backend='jax' or "
+                "'numpy' for swor/bernoulli designs"
+            )
         rng = np.random.default_rng(seed)
         a, ma, ia = self._pack_partition(np.asarray(A), rng, "swor")
         if self.kernel.two_sample:

@@ -21,7 +21,11 @@ import numpy as np
 
 from tuplewise_tpu.backends.base import register_backend
 from tuplewise_tpu.ops.kernels import Kernel, get_kernel
-from tuplewise_tpu.parallel.partition import partition_indices, partition_two_sample
+from tuplewise_tpu.parallel.partition import (
+    draw_pair_design,
+    partition_indices,
+    partition_two_sample,
+)
 
 _BLOCK = 4096
 
@@ -186,12 +190,29 @@ class NumpyBackend:
         *,
         n_pairs: int,
         seed: int = 0,
+        design: str = "swr",
     ) -> float:
-        """Incomplete U-statistic: B tuples drawn uniformly with
-        replacement from the tuple grid [SURVEY §1.1, §4.3]."""
+        """Incomplete U-statistic: B tuples drawn from the tuple grid
+        [SURVEY §1.1, §4.3]. Sampling designs (the incomplete-U
+        machinery of Clemencon/Colin/Bellet, PAPERS.md:6):
+
+        * ``"swr"`` — B i.i.d. uniform draws WITH replacement (the
+          paper's default; extra variance term Var(h)/B).
+        * ``"swor"`` — B DISTINCT tuples (without replacement): same
+          mean, variance reduced by the finite-population correction.
+        * ``"bernoulli"`` — every tuple kept independently with
+          probability B/|grid| (simulated exactly as a Binomial draw
+          of the sample size, then a uniform distinct sample); the
+          estimator divides by the REALIZED count.
+        """
         k = self.kernel
         rng = np.random.default_rng(seed)
         if k.kind == "triplet":
+            if design != "swr":
+                raise ValueError(
+                    "triplet incomplete sampling supports design='swr' "
+                    f"only, got {design!r}"
+                )
             n1, n2 = len(A), len(B)
             i = rng.integers(0, n1, size=n_pairs)
             # j must differ from i: draw from n1-1 and shift past i.
@@ -200,13 +221,11 @@ class NumpyBackend:
             kk = rng.integers(0, n2, size=n_pairs)
             vals = k.triplet_values(A[i], A[j], B[kk], np)
             return float(np.mean(vals))
-        if k.two_sample:
-            i = rng.integers(0, len(A), size=n_pairs)
-            j = rng.integers(0, len(B), size=n_pairs)
-            return float(np.mean(k.pair_elementwise(A[i], B[j], np)))
-        # one-sample: draw i != j uniformly from the off-diagonal grid
-        n = len(A)
-        i = rng.integers(0, n, size=n_pairs)
-        j = rng.integers(0, n - 1, size=n_pairs)
-        j = np.where(j >= i, j + 1, j)
-        return float(np.mean(k.pair_elementwise(A[i], A[j], np)))
+        one_sample = not k.two_sample
+        n1 = len(A)
+        n2 = n1 - 1 if one_sample else len(B)
+        i, j = draw_pair_design(rng, n1, n2, n_pairs, design,
+                                one_sample=one_sample)
+        if one_sample:
+            return float(np.mean(k.pair_elementwise(A[i], A[j], np)))
+        return float(np.mean(k.pair_elementwise(A[i], B[j], np)))

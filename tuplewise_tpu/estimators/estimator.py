@@ -133,10 +133,14 @@ class Estimator:
             n_rounds=n_rounds, seed=seed, scheme=scheme,
             dropped_workers=dropped_workers))
 
-    def incomplete(self, A, B=None, *, n_pairs: int, seed: int = 0) -> float:
-        """U~_B — B tuples sampled with replacement [SURVEY §1.2.4]."""
+    def incomplete(self, A, B=None, *, n_pairs: int, seed: int = 0,
+                   design: str = "swr") -> float:
+        """U~_B — B sampled tuples [SURVEY §1.2.4]. ``design``:
+        "swr" (with replacement, the default), "swor" (distinct tuples,
+        finite-population variance reduction), or "bernoulli"
+        (independent per-tuple inclusion at rate B/|grid|)."""
         if n_pairs < 1:
             raise ValueError(f"n_pairs must be >= 1, got {n_pairs}")
         A, B = self._prep(A, B)
         return float(self.backend.incomplete(
-            A, B, n_pairs=n_pairs, seed=seed))
+            A, B, n_pairs=n_pairs, seed=seed, design=design))

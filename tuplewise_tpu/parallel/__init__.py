@@ -9,6 +9,7 @@ from tuplewise_tpu.parallel.faults import (
     survivors,
 )
 from tuplewise_tpu.parallel.partition import (
+    draw_pair_design,
     partition_indices,
     partition_two_sample,
     pack_shards,
@@ -17,6 +18,7 @@ from tuplewise_tpu.parallel.partition import (
 
 __all__ = [
     "alive_mask",
+    "draw_pair_design",
     "normalize_dropped",
     "partition_indices",
     "partition_two_sample",

@@ -71,6 +71,78 @@ def partition_two_sample(
 
 
 # ---------------------------------------------------------------------------
+# Incomplete-U pair sampling designs [SURVEY §1.1 incomplete; PAPERS.md:6]
+# ---------------------------------------------------------------------------
+
+def _distinct_uniform(
+    rng: np.random.Generator, grid: int, size: int
+) -> np.ndarray:
+    """``size`` distinct uniform draws from range(grid) without ever
+    materializing the grid: exact permutation-based choice for small
+    grids, draw-and-dedup (uniform over distinct subsets) for huge ones."""
+    if size > grid:
+        raise ValueError(f"cannot draw {size} distinct tuples from a "
+                         f"grid of {grid}")
+    if grid <= max(4 * size, 1 << 20):
+        return rng.choice(grid, size=size, replace=False)
+    out = np.unique(rng.integers(0, grid, size=size + size // 8 + 16))
+    while len(out) < size:
+        extra = rng.integers(0, grid, size=size // 4 + 16)
+        out = np.unique(np.concatenate([out, extra]))
+    rng.shuffle(out)
+    return out[:size]
+
+
+def draw_pair_design(
+    rng: np.random.Generator,
+    n1: int,
+    n2: int,
+    n_pairs: int,
+    design: str = "swr",
+    *,
+    one_sample: bool = False,
+):
+    """(i, j) index arrays sampling the n1 x n2 tuple grid.
+
+    Designs (incomplete U-statistics, Clemencon/Colin/Bellet):
+      "swr"       — n_pairs i.i.d. uniform draws with replacement;
+      "swor"      — n_pairs DISTINCT tuples;
+      "bernoulli" — every tuple kept independently with probability
+                    n_pairs/grid, simulated exactly: realized sample
+                    size ~ Binomial(grid, p), then a uniform distinct
+                    sample of that size (floored at 1 so the estimator
+                    stays defined).
+
+    one_sample: the grid is the OFF-DIAGONAL of an (n1 x n1) grid,
+    encoded with n2 = n1 - 1 columns; returned j is shifted past i so
+    callers index the original array directly.
+    """
+    grid = n1 * n2
+    if design == "swr":
+        i = rng.integers(0, n1, size=n_pairs)
+        j = rng.integers(0, n2, size=n_pairs)
+    elif design in ("swor", "bernoulli"):
+        if design == "bernoulli":
+            p = n_pairs / grid
+            if p > 1.0:
+                raise ValueError(
+                    f"bernoulli rate n_pairs/grid = {p:.3f} exceeds 1")
+            size = max(1, int(rng.binomial(grid, p)))
+        else:
+            size = n_pairs
+        lin = _distinct_uniform(rng, grid, size)
+        i, j = lin // n2, lin % n2
+    else:
+        raise ValueError(
+            f"unknown sampling design {design!r}; "
+            "choose 'swr', 'swor', or 'bernoulli'"
+        )
+    if one_sample:
+        j = np.where(j >= i, j + 1, j)
+    return np.asarray(i), np.asarray(j)
+
+
+# ---------------------------------------------------------------------------
 # Packing for the device mesh: static [N, cap] blocks + validity masks
 # ---------------------------------------------------------------------------
 

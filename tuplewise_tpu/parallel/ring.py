@@ -236,3 +236,78 @@ def _triplet_block(kernel, a, ma, ia, p, mp, ip, yk, mk, tile):
         kernel, a, yk, mask_x=ma, mask_y=mk, ids_x=ia,
         positives=p, mask_p=mp, ids_p=ip, tile=tile,
     )
+
+
+def _hier_cycle(state, axes, step_fn, acc):
+    """Visit all N = prod(axis sizes) ring positions of ``state``:
+    nested scans rotate over the LAST axis innermost (fast/ICI) and hop
+    earlier axes once per completed inner cycle (slow/DCN) — so a full
+    cycle is the identity permutation and cross-host hops are minimal.
+    ``step_fn(acc, state) -> acc`` runs at every position."""
+    ax, rest = axes[0], axes[1:]
+
+    def body(carry, _):
+        acc, st = carry
+        if rest:
+            acc, st = _hier_cycle(st, rest, step_fn, acc)
+        else:
+            acc = step_fn(acc, st)
+        return (acc, _rotate(st, ax)), None
+
+    (acc, state), _ = lax.scan(
+        body, (acc, state), None, length=lax.axis_size(ax)
+    )
+    return acc, state
+
+
+def ring_triplet_stats_2d(
+    kernel,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask_x: Optional[jnp.ndarray] = None,
+    mask_y: Optional[jnp.ndarray] = None,
+    ids_x: Optional[jnp.ndarray] = None,
+    *,
+    ici_axis: str,
+    dcn_axis: str,
+    tile: int = 64,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Degree-3 complete statistic over a 2-D (dcn, ici) mesh: the
+    TRIPLE-nested hierarchical ring. Anchors stay resident; the
+    positives block walks all N = D*I ring positions (ici-inner,
+    dcn-outer), and for each position the negatives block completes a
+    full hierarchical cycle — N^2 compute steps, with DCN crossed only
+    once per completed ICI cycle at either level. Same invariance
+    contract as the 1-D ring_triplet_stats.
+
+    ids_x is REQUIRED (global row ids) for anchor/positive exclusion,
+    exactly as in the 1-D version.
+    """
+    if ids_x is None:
+        raise ValueError(
+            "ring_triplet_stats_2d requires global ids_x; per-shard "
+            "local indices would mis-exclude cross-shard pairs"
+        )
+    dtype = x.dtype
+    mx = jnp.ones(x.shape[0], dtype) if mask_x is None else mask_x
+    my = jnp.ones(y.shape[0], dtype) if mask_y is None else mask_y
+    ix = ids_x.astype(jnp.int32)
+    axes = (dcn_axis, ici_axis)
+
+    def at_p_position(acc, p_state):
+        p, mp, ip = p_state
+
+        def at_y_position(acc2, y_state):
+            yv, myv = y_state
+            s, c = acc2
+            ds, dc = _triplet_block(
+                kernel, x, mx, ix, p, mp, ip, yv, myv, tile
+            )
+            return (s + ds, c + dc)
+
+        acc, _ = _hier_cycle((y, my), axes, at_y_position, acc)
+        return acc
+
+    init = (jnp.zeros((), dtype), jnp.zeros((), dtype))
+    (s, c), _ = _hier_cycle((x, mx, ix), axes, at_p_position, init)
+    return lax.psum(s, axes), lax.psum(c, axes)

@@ -5,16 +5,27 @@ named `fold_in` chains — per-shard, per-Monte-Carlo-rep, per-repartition-
 round — so shards never reuse keys and every run is reproducible from one
 integer seed. (NumPy and JAX RNGs cannot match bit-for-bit; parity tests
 are exact for complete-U paths and statistical for sampled paths.)
+
+``audit_keys()`` is the assertion-level key-discipline check of
+[SURVEY §5.3]: inside the scope, every host-side ``fold`` chain
+(purpose + concrete indices) is recorded and a repeated chain — the
+key-reuse bug class the discipline exists to prevent — raises
+immediately. Folds with traced (in-jit) indices can't be observed
+per-value and are skipped; the audit covers the host orchestration
+layer, where the distinct-per-shard/rep/round structure is decided.
 """
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
+import threading
 
 import jax
 
 
 _PURPOSES = {}
+_AUDIT = threading.local()
 
 
 def _purpose_id(purpose: str) -> int:
@@ -35,7 +46,54 @@ def fold(key: jax.Array, purpose: str, *indices: int) -> jax.Array:
     Usage: ``fold(key, "repartition", t)``, ``fold(key, "mc_rep", m)``.
     Indices may be tracers (e.g. a lax.scan counter).
     """
+    _record_fold(key, purpose, indices)
     key = jax.random.fold_in(key, _purpose_id(purpose))
     for ix in indices:
         key = jax.random.fold_in(key, ix)
     return key
+
+
+# --------------------------------------------------------------------- #
+# key-discipline audit [SURVEY §5.3]                                    #
+# --------------------------------------------------------------------- #
+
+def _concrete(x) -> bool:
+    """True when x is an observable host value (not a jit tracer)."""
+    import jax.core
+
+    return not isinstance(x, jax.core.Tracer)
+
+
+def _record_fold(key, purpose, indices) -> None:
+    seen = getattr(_AUDIT, "seen", None)
+    if seen is None:
+        return
+    if not (_concrete(key) and all(_concrete(i) for i in indices)):
+        return  # in-jit folds: per-value observation impossible
+    import numpy as np
+
+    chain = (
+        np.asarray(jax.random.key_data(key)).tobytes(),
+        purpose,
+        tuple(int(i) for i in indices),
+    )
+    if chain in seen:
+        raise AssertionError(
+            f"PRNG key-discipline violation: fold chain "
+            f"purpose={purpose!r} indices={chain[2]} derived twice from "
+            "the same parent key — two consumers would draw identical "
+            "randomness. Give each consumer a distinct purpose or index."
+        )
+    seen.add(chain)
+
+
+@contextlib.contextmanager
+def audit_keys():
+    """``with audit_keys(): ...`` — raise on any repeated host-side fold
+    chain inside the scope (the assertion-level check of SURVEY §5.3)."""
+    prev = getattr(_AUDIT, "seen", None)
+    _AUDIT.seen = set() if prev is None else prev
+    try:
+        yield
+    finally:
+        _AUDIT.seen = prev
