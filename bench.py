@@ -54,10 +54,15 @@ def _tpu_pairs_per_sec(n=1 << 20, tile_a=2048, tile_b=8192, reps=3):
     except Exception as e:  # pragma: no cover - hardware-dependent
         print(f"[bench] pallas unavailable ({e!r}); XLA path", file=sys.stderr)
         # honor the requested tiles, shrunk to pair_stats' exact-count
-        # bound (tile_a * tile_b < 2^24)
-        ta = tile_a
-        while ta * tile_b >= 1 << 24:
-            ta //= 2
+        # bound (tile_a * tile_b < 2^24); shrink the larger dim each
+        # step and never drive either below 1
+        ta, tb = tile_a, tile_b
+        while ta * tb >= 1 << 24 and (ta > 1 or tb > 1):
+            if ta >= tb:
+                ta = max(1, ta // 2)
+            else:
+                tb = max(1, tb // 2)
+        tile_b = tb
         f = jax.jit(
             lambda a, b: pair_tiles.pair_stats(
                 auc_kernel, a, b, tile_a=ta, tile_b=tile_b

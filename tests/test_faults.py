@@ -157,3 +157,11 @@ class TestDropRenormalizeMesh:
         from tuplewise_tpu.parallel.mesh import make_mesh
 
         assert check_mesh_health(make_mesh(8))
+
+    def test_health_check_2d(self):
+        # regression: the probe must psum over ALL mesh axes — summing
+        # only axis 0 of a (2, 4) mesh counts 2 devices, not 8, and
+        # wrongly reports a healthy mesh as failed
+        from tuplewise_tpu.parallel.mesh import make_mesh_2d
+
+        assert check_mesh_health(make_mesh_2d(2, 4))

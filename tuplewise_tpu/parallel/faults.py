@@ -85,11 +85,14 @@ def check_mesh_health(mesh) -> bool:
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    axis = mesh.axis_names[0]
+    axes = tuple(mesh.axis_names)
     n = int(np.prod(mesh.devices.shape))
 
     def probe():
-        return jax.lax.psum(jnp.ones(()), axis)
+        # psum over EVERY mesh axis: on a 2-D (dcn x ici) mesh summing a
+        # single axis would count only that axis's extent and wrongly
+        # report an unhealthy mesh.
+        return jax.lax.psum(jnp.ones(()), axes)
 
     out = jax.jit(
         jax.shard_map(
