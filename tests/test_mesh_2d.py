@@ -48,6 +48,15 @@ class TestDoubleRingInvariance:
         ref = Estimator("auc", backend="numpy").complete(s1, s2)
         assert abs(est2d.complete(s1, s2) - ref) < 1e-6
 
+    def test_complete_pallas_double_ring(self, scores, mesh2d):
+        s1, s2 = scores
+        s1, s2 = s1[:1237], s2[:1011]
+        ref = Estimator("auc", backend="numpy").complete(s1, s2)
+        got = Estimator("auc", backend="mesh", mesh=mesh2d,
+                        tile_a=64, tile_b=64,
+                        impl="pallas").complete(s1, s2)
+        assert abs(got - ref) < 1e-6
+
     def test_one_sample_complete(self, mesh2d):
         rng = np.random.default_rng(2)
         A = rng.standard_normal((300, 3))

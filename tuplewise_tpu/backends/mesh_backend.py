@@ -59,13 +59,30 @@ class MeshBackend:
         tile_a: int = 512,
         tile_b: int = 512,
         triplet_tile: int = 32,
+        impl: str = "auto",
     ):
+        """impl selects the ring hot-loop implementation for diff
+        kernels: "pallas" (mask-aware hand-tiled kernel, ~4x the XLA
+        scan per chip — ops.pallas_pairs), "xla" (checkpointed tile
+        scan), or "auto" (pallas on TPU, xla elsewhere; the CPU test
+        mesh exercises pallas via interpret mode only when asked
+        explicitly, because interpret mode is slow)."""
+        if impl not in ("auto", "xla", "pallas"):
+            raise ValueError(f"impl must be auto|xla|pallas, got {impl!r}")
         self.kernel = get_kernel(kernel)
         self.mesh = mesh if mesh is not None else make_mesh(n_workers)
         self.n_shards = int(np.prod(self.mesh.devices.shape))
         self.dtype = dtype
         self.tile_a, self.tile_b = tile_a, tile_b
         self.triplet_tile = triplet_tile
+        # the MESH's devices decide the platform, not the default
+        # backend: a CPU mesh on a TPU-attached host must not compile
+        # Mosaic kernels (and vice versa for interpret mode)
+        mesh_platform = self.mesh.devices.flat[0].platform
+        if impl == "auto":
+            impl = "pallas" if mesh_platform == "tpu" else "xla"
+        self.impl = impl
+        self._interpret = mesh_platform != "tpu"
         k = self.kernel
         N = self.n_shards
         # all mesh axes together form the worker axis: 1-D ("w",) meshes
@@ -103,7 +120,8 @@ class MeshBackend:
                     ids_a=None if k.two_sample else ia[0],
                     ids_b=None if k.two_sample else ib[0],
                     ici_axis=axes[1], dcn_axis=axes[0],
-                    tile_a=tile_a, tile_b=tile_b,
+                    tile_a=tile_a, tile_b=tile_b, impl=impl,
+                    interpret=self._interpret,
                 )
             else:
                 s, c = ring.ring_pair_stats(
@@ -112,6 +130,7 @@ class MeshBackend:
                     ids_a=None if k.two_sample else ia[0],
                     ids_b=None if k.two_sample else ib[0],
                     axis_name=axes[0], tile_a=tile_a, tile_b=tile_b,
+                    impl=impl, interpret=self._interpret,
                 )
             return s, c
 

@@ -11,9 +11,20 @@ grid (O(n1/Ta) scalars, never the O(n1*n2/(Ta*Tb)) per-cell grid), and
 the row partials tree-reduce outside.
 
 The g(d) body comes from the Kernel's own diff_fn (ops.kernels) — no
-duplicated surrogate definitions. Used for unmasked complete statistics;
-masked, id-aware, and differentiating callers use ops.pair_tiles (XLA).
-CPU test execution uses interpret mode [pallas_guide: interpret=True].
+duplicated surrogate definitions. Two variants share the layout:
+
+* ``pallas_pair_sum`` — unmasked complete statistics (sizes must be tile
+  multiples); count is n1*n2 by construction.
+* ``pallas_masked_pair_sum`` — mask-aware variant for the ring hot loop
+  (parallel.ring): pads any size up to tile multiples with zero-mask
+  rows, weights each pair by ma_i*mb_j inside the kernel, and lets the
+  caller recover the pair count as sum(ma)*sum(mb). This is what makes
+  the DISTRIBUTED estimator run at Pallas throughput instead of the XLA
+  scan path [SURVEY §7 step 5 "wall-clock target"].
+
+Id-aware and differentiating callers use ops.pair_tiles (XLA) — these
+kernels define no custom VJP. CPU test execution uses interpret mode
+[pallas_guide: interpret=True].
 """
 
 from __future__ import annotations
@@ -99,4 +110,87 @@ def pallas_pair_sum(
     # tree-reduce the per-row-block partials, folding in each block's
     # residual: comp = (t - s) - y accumulates the NEGATIVE of the lost
     # low-order bits, so the true block sum is s - comp
+    return jnp.sum(partials[:, 0] - partials[:, 1])
+
+
+def _masked_pair_sum_kernel(a_ref, b_ref, ma_ref, mb_ref, o_ref, *, g):
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[i, 0] = 0.0
+        o_ref[i, 1] = 0.0
+
+    # [Ta, 1] - [1, Tb] -> [Ta, Tb] sublane x lane broadcast. The b-mask
+    # applies inside the lane reduction and the a-mask on the resulting
+    # [Ta, 1] column, so only ONE full-tile intermediate (g(d) * mb) is
+    # ever live — a second [Ta, Tb] weight grid spills registers past
+    # VMEM at lane-wide tiles, and a per-tile fully-valid branch
+    # (pl.when) measured SLOWER than the straight multiply (it breaks
+    # Mosaic's grid pipelining), so every tile takes the weighted path:
+    # ~85% of the unmasked kernel's throughput at n=2^20.
+    d = a_ref[:, :] - b_ref[:, :]
+    row = jnp.sum(g(d) * mb_ref[:, :], axis=1, keepdims=True)
+    x = jnp.sum(row * ma_ref[:, :])
+    y = x - o_ref[i, 1]
+    t = o_ref[i, 0] + y
+    o_ref[i, 1] = (t - o_ref[i, 0]) - y
+    o_ref[i, 0] = t
+
+
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kernel", "tile_a", "tile_b", "interpret")
+)
+def pallas_masked_pair_sum(
+    s1: jnp.ndarray,
+    s2: jnp.ndarray,
+    m1: jnp.ndarray,
+    m2: jnp.ndarray,
+    *,
+    kernel: Kernel,
+    tile_a: int = 256,
+    tile_b: int = 2048,
+    interpret: bool = False,
+):
+    """Weighted sum of g(s1_i - s2_j) * m1_i * m2_j over the pair grid.
+
+    Any sizes accepted: inputs are zero-padded to tile multiples, and a
+    zero mask makes padded rows/cols weightless, so the value equals the
+    XLA pair_stats sum on the unpadded data (same Kahan contract). The
+    matching pair count is sum(m1) * sum(m2) — callers compute it with
+    two O(n) reductions; it is not returned here.
+    """
+    if kernel.kind != "diff":
+        raise ValueError(
+            f"pallas pair-sum handles diff kernels only, got "
+            f"{kernel.name!r} (kind={kernel.kind})"
+        )
+    from tuplewise_tpu.ops.pair_tiles import _pad_axis0
+
+    s1, m1 = _pad_axis0(s1, tile_a), _pad_axis0(m1, tile_a)
+    s2, m2 = _pad_axis0(s2, tile_b), _pad_axis0(m2, tile_b)
+    n1, n2 = s1.shape[0], s2.shape[0]
+    g1, g2 = n1 // tile_a, n2 // tile_b
+    partials = pl.pallas_call(
+        functools.partial(
+            _masked_pair_sum_kernel, g=lambda d: kernel.diff(d, jnp)
+        ),
+        out_shape=jax.ShapeDtypeStruct((g1, 2), jnp.float32),
+        grid=(g1, g2),
+        in_specs=[
+            pl.BlockSpec((tile_a, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tile_b), lambda i, j: (0, j)),
+            pl.BlockSpec((tile_a, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tile_b), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (g1, 2), lambda i, j: (0, 0), memory_space=pltpu.SMEM
+        ),
+        interpret=interpret,
+    )(
+        s1.reshape(n1, 1), s2.reshape(1, n2),
+        m1.reshape(n1, 1), m2.reshape(1, n2),
+    )
     return jnp.sum(partials[:, 0] - partials[:, 1])

@@ -37,28 +37,75 @@ def _rotate(state, axis_name):
     return tuple(lax.ppermute(x, axis_name, perm) for x in state)
 
 
-def _ring_accumulate(
-    kernel, a, mask_a, ids_a, visiting, *,
-    axis_name, tile_a, tile_b, use_ids, acc,
+def _make_stats_fn(
+    kernel, mask_a, ids_a, *, tile_a, tile_b, use_ids, impl, interpret=None,
 ):
-    """One full rotation of the visiting (b, mask, ids) state around
-    ``axis_name``, accumulating tiled pair stats against the resident
-    block at every stop. Returns (acc, visiting) with the visiting state
-    back at its starting shard (a full cycle is the identity
-    permutation), so callers can nest rotations hierarchically."""
-    n_shards = lax.axis_size(axis_name)
+    """Build the per-stop (resident, visiting) -> (sum, count) reduction.
 
-    def step(carry, _):
-        (s, c), vis = carry
-        bv, mbv, ibv = vis
-        ds, dc = pair_tiles.pair_stats(
+    impl="pallas" routes diff kernels without id exclusion through the
+    hand-tiled mask-aware Pallas kernel (ops.pallas_pairs) — ~4x the XLA
+    scan path per chip, which is what lets the DISTRIBUTED estimator run
+    at single-chip Pallas throughput [SURVEY §7 step 5]. Everything else
+    (feature kernels, id-aware one-sample paths, impl="xla") uses the
+    checkpointed XLA tile reduction. interpret mode makes the Pallas
+    path run on the CPU test mesh, so parity tests cover it; pass
+    interpret explicitly when the executing mesh's platform differs
+    from the default backend (MeshBackend does)."""
+    if impl == "pallas" and kernel.kind == "diff" and not use_ids:
+        from tuplewise_tpu.ops.pallas_pairs import pallas_masked_pair_sum
+
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+
+        def stats_fn(a, bv, mbv, ibv):
+            del ibv
+            ma = jnp.ones(a.shape[0], a.dtype) if mask_a is None else mask_a
+            s = pallas_masked_pair_sum(
+                a, bv, ma, mbv, kernel=kernel,
+                tile_a=tile_a, tile_b=tile_b, interpret=interpret,
+            )
+            # the kernel accumulates in f32 regardless of input dtype;
+            # cast back so the ring's scan carry keeps the caller's dtype
+            return (
+                s.astype(a.dtype),
+                (jnp.sum(ma) * jnp.sum(mbv)).astype(a.dtype),
+            )
+
+        return stats_fn
+
+    def stats_fn(a, bv, mbv, ibv):
+        return pair_tiles.pair_stats(
             kernel, a, bv,
             mask_a=mask_a, mask_b=mbv,
             ids_a=ids_a if use_ids else None,
             ids_b=ibv if use_ids else None,
             tile_a=tile_a, tile_b=tile_b,
         )
-        return ((s + ds, c + dc), _rotate(vis, axis_name)), None
+
+    return stats_fn
+
+
+def _ring_accumulate(stats_fn, a, visiting, *, axis_name, acc):
+    """One full rotation of the visiting (b, mask, ids) state around
+    ``axis_name``, accumulating tiled pair stats against the resident
+    block at every stop. Returns (acc, visiting) with the visiting state
+    back at its starting shard (a full cycle is the identity
+    permutation), so callers can nest rotations hierarchically.
+
+    Double-buffered [SURVEY §7 "Ring step vs compute overlap"]: the
+    ppermute that fetches the NEXT visiting block is issued before the
+    current block's tile reduction in program order, and neither depends
+    on the other's result, so XLA's latency-hiding scheduler can fly the
+    collective-permute over the reduction (async collective-permute on
+    TPU). The rotated state rides the scan carry as the second buffer."""
+    n_shards = lax.axis_size(axis_name)
+
+    def step(carry, _):
+        (s, c), vis = carry
+        bv, mbv, ibv = vis
+        nxt = _rotate(vis, axis_name)      # in flight during the reduction
+        ds, dc = stats_fn(a, bv, mbv, ibv)
+        return ((s + ds, c + dc), nxt), None
 
     (acc, visiting), _ = lax.scan(
         step, (acc, visiting), None, length=n_shards
@@ -78,12 +125,18 @@ def ring_pair_stats(
     axis_name: str,
     tile_a: int = 1024,
     tile_b: int = 1024,
+    impl: str = "xla",
+    interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Global (sum, count) of h over ALL cross- and within-shard pairs.
 
     a, b: this shard's blocks of the two samples (one-sample statistics
     pass the same block with its ids). The b-side block (with its mask
     and ids) rotates around the ring; the a-side stays resident.
+
+    impl: "xla" (checkpointed tile scan) or "pallas" (mask-aware Pallas
+    kernel for diff kernels without ids; anything else falls back to
+    XLA). Pallas tiles are (tile_a, tile_b) directly.
 
     Returns the SAME (sum, count) on every shard (psum'd), equal to the
     single-device pair_stats over the concatenated data — the ring
@@ -99,10 +152,14 @@ def ring_pair_stats(
     use_ids = ids_a is not None
     ib = jnp.zeros(b.shape[0], jnp.int32) if ids_b is None else ids_b.astype(jnp.int32)
 
+    stats_fn = _make_stats_fn(
+        kernel, mask_a, ids_a,
+        tile_a=tile_a, tile_b=tile_b, use_ids=use_ids, impl=impl,
+        interpret=interpret,
+    )
     (s, c), _ = _ring_accumulate(
-        kernel, a, mask_a, ids_a, (b, mb, ib),
-        axis_name=axis_name, tile_a=tile_a, tile_b=tile_b,
-        use_ids=use_ids,
+        stats_fn, a, (b, mb, ib),
+        axis_name=axis_name,
         acc=(jnp.zeros((), dtype), jnp.zeros((), dtype)),
     )
     return lax.psum(s, axis_name), lax.psum(c, axis_name)
@@ -121,6 +178,8 @@ def ring_pair_stats_2d(
     dcn_axis: str,
     tile_a: int = 1024,
     tile_b: int = 1024,
+    impl: str = "xla",
+    interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Hierarchical cross-shard all-pairs over a 2-D (dcn, ici) mesh —
     the multi-host layout of [SURVEY §5.8]: chips within a host/pod slice
@@ -144,12 +203,16 @@ def ring_pair_stats_2d(
     ib = jnp.zeros(b.shape[0], jnp.int32) if ids_b is None else ids_b.astype(jnp.int32)
     n_dcn = lax.axis_size(dcn_axis)
 
+    stats_fn = _make_stats_fn(
+        kernel, mask_a, ids_a,
+        tile_a=tile_a, tile_b=tile_b, use_ids=use_ids, impl=impl,
+        interpret=interpret,
+    )
+
     def outer(carry, _):
         acc, vis = carry
         acc, vis = _ring_accumulate(
-            kernel, a, mask_a, ids_a, vis,
-            axis_name=ici_axis, tile_a=tile_a, tile_b=tile_b,
-            use_ids=use_ids, acc=acc,
+            stats_fn, a, vis, axis_name=ici_axis, acc=acc,
         )
         return (acc, _rotate(vis, dcn_axis)), None
 
