@@ -65,9 +65,26 @@ class TestDoubleRingInvariance:
                         tile_a=64, tile_b=64).complete(A)
         assert abs(got - ref) / abs(ref) < 1e-5
 
-    def test_triplet_on_2d_mesh_raises(self, mesh2d):
-        with pytest.raises(ValueError, match="1-D mesh"):
-            Estimator("triplet_indicator", backend="mesh", mesh=mesh2d)
+    def test_triplet_complete_hier_double_ring(self, mesh2d):
+        """Degree-3 on the (2, 4) mesh: the triple-nested hierarchical
+        ring must reproduce the oracle exactly, mirroring the 1-D
+        double-ring test [BASELINE config 4 on a 2-D mesh]."""
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((48, 3))
+        Y = rng.standard_normal((40, 3))
+        ref = Estimator("triplet_indicator", backend="numpy").complete(X, Y)
+        got = Estimator("triplet_indicator", backend="mesh", mesh=mesh2d,
+                        triplet_tile=8).complete(X, Y)
+        assert abs(got - ref) < 1e-6
+
+    def test_triplet_complete_hier_ragged(self, mesh2d):
+        rng = np.random.default_rng(4)
+        X = rng.standard_normal((37, 3))   # not multiples of 8 shards
+        Y = rng.standard_normal((29, 3))
+        ref = Estimator("triplet_hinge", backend="numpy").complete(X, Y)
+        got = Estimator("triplet_hinge", backend="mesh", mesh=mesh2d,
+                        triplet_tile=8).complete(X, Y)
+        assert abs(got - ref) / max(abs(ref), 1) < 1e-5
 
 
 class TestSchemesOn2D:

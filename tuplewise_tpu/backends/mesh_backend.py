@@ -92,11 +92,6 @@ class MeshBackend:
         self._axes = axes
         if len(axes) > 2:
             raise ValueError(f"mesh must be 1-D or 2-D, got axes {axes}")
-        if len(axes) == 2 and k.kind == "triplet":
-            raise ValueError(
-                "degree-3 kernels currently require a 1-D mesh (the "
-                "triplet double-ring does not yet nest over dcn)"
-            )
         PA = P(axes)  # shard axis 0 over every mesh axis
 
         shard2 = NamedSharding(self.mesh, PA)             # [N, ...] blocks
@@ -108,7 +103,12 @@ class MeshBackend:
             # axis names come from the mesh itself: the TRAILING axis is
             # the fast ICI ring, a leading axis (if any) is DCN — no
             # particular name is required
-            if k.kind == "triplet":
+            if k.kind == "triplet" and len(axes) == 2:
+                s, c = ring.ring_triplet_stats_2d(
+                    k, a[0], b[0], mask_x=ma[0], mask_y=mb[0], ids_x=ia[0],
+                    ici_axis=axes[1], dcn_axis=axes[0], tile=triplet_tile,
+                )
+            elif k.kind == "triplet":
                 s, c = ring.ring_triplet_stats(
                     k, a[0], b[0], mask_x=ma[0], mask_y=mb[0], ids_x=ia[0],
                     axis_name=axes[-1], tile=triplet_tile,
