@@ -97,15 +97,50 @@ class TestEstimatorDesigns:
                for m in range(300)]
         assert np.var(swor) < 0.6 * np.var(swr)
 
-    def test_mesh_rejects_non_swr(self, scores):
+    @pytest.mark.parametrize("design", ["swor", "bernoulli"])
+    def test_mesh_matches_numpy_indices(self, scores, design):
+        """The mesh path draws the SAME global tuple set as the numpy
+        oracle at the same seed (shared host sampler), so the estimate
+        must match to f32 rounding — exact parity, not just
+        unbiasedness."""
         import jax
 
         if jax.device_count() < 8:
             pytest.skip("needs 8 virtual devices")
         s1, s2 = scores
         est = Estimator("auc", backend="mesh", n_workers=8)
-        with pytest.raises(ValueError, match="within shards"):
-            est.incomplete(s1, s2, n_pairs=100, design="swor")
+        ref = Estimator("auc", backend="numpy")
+        for seed in (0, 3):
+            got = est.incomplete(s1, s2, n_pairs=4000, seed=seed,
+                                 design=design)
+            want = ref.incomplete(s1, s2, n_pairs=4000, seed=seed,
+                                  design=design)
+            assert abs(got - want) < 1e-6, (design, seed)
+
+    def test_mesh_one_sample_swor(self):
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        rng = np.random.default_rng(7)
+        A = rng.standard_normal((120, 3))
+        got = Estimator("scatter", backend="mesh", n_workers=8).incomplete(
+            A, n_pairs=3000, seed=5, design="swor")
+        want = Estimator("scatter", backend="numpy").incomplete(
+            A, n_pairs=3000, seed=5, design="swor")
+        assert abs(got - want) / max(abs(want), 1) < 1e-5
+
+    def test_mesh_triplet_rejects_non_swr(self):
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        rng = np.random.default_rng(9)
+        X = rng.standard_normal((48, 3))
+        Y = rng.standard_normal((40, 3))
+        est = Estimator("triplet_indicator", backend="mesh", n_workers=8)
+        with pytest.raises(ValueError, match="swr"):
+            est.incomplete(X, Y, n_pairs=100, design="swor")
 
     def test_cpp_backend_inherits_designs(self, scores):
         from tuplewise_tpu.native import load_pair_lib

@@ -270,6 +270,34 @@ class MeshBackend:
             incomplete_fn, static_argnames=("n_pairs",)
         )
 
+        # ---- incomplete with a host-designed GLOBAL tuple set --------- #
+        def designed_body(av, bv, w):
+            """[1, per] blocks of gathered tuple rows + weight mask;
+            the weighted global mean prices exactly the realized tuple
+            set (swor's distinct count, bernoulli's Binomial draw)."""
+            vals = k.pair_elementwise(av[0], bv[0], jnp)
+            s = lax.psum(jnp.sum(vals * w[0], dtype=vals.dtype), axes)
+            c = lax.psum(jnp.sum(w[0], dtype=vals.dtype), axes)
+            return s / c
+
+        def designed_fn(Ag, Bg, i, j, w):
+            """i, j: [N, per] global row indices sharded over workers.
+            The .at[].get regather is the communication being priced:
+            each worker fetches the rows of ITS sampled tuples from
+            whichever shards own them (XLA lowers to the cross-shard
+            gather), then evaluates its block locally."""
+            Ai = Ag.at[i].get(out_sharding=shard2)
+            Bj = Bg.at[j].get(out_sharding=shard2)
+            return jax.shard_map(
+                designed_body,
+                mesh=self.mesh,
+                in_specs=(PA, PA, PA),
+                out_specs=P(),
+                check_vma=False,
+            )(Ai, Bj, w)
+
+        self._designed = jax.jit(designed_fn)
+
     # ------------------------------------------------------------------ #
     # packing helpers (host side)                                        #
     # ------------------------------------------------------------------ #
@@ -346,26 +374,66 @@ class MeshBackend:
             n1=len(A), n2=len(B), n_rounds=n_rounds, scheme=scheme))
 
     def incomplete(self, A, B=None, *, n_pairs, seed=0, design="swr"):
-        """Within-shard sampling over a random packing [SURVEY §1.2.4].
+        """Incomplete U over B sampled tuples [SURVEY §1.2.4].
 
-        Each shard draws ceil(n_pairs / N) local tuples, so the total
-        tuple budget is n_pairs rounded UP to a multiple of N (never
-        under-samples the requested B)."""
-        if design != "swr":
+        design="swr" samples WITHIN each shard of a random packing, on
+        device inside the jitted program: each shard draws
+        ceil(n_pairs / N) local tuples, so the total budget is n_pairs
+        rounded UP to a multiple of N (never under-samples B).
+
+        design="swor"/"bernoulli" use the shared host sampler
+        (parallel.partition.draw_pair_design) to draw the DISTINCT
+        global tuple set — identical indices to the numpy/jax backends
+        at the same seed — then shard the tuple list over workers and
+        regather each worker's sampled rows across shards (the priced
+        communication) before the local kernel evaluation. The realized
+        tuple count is honored through a weight mask (bernoulli's
+        Binomial size varies per seed, so each new size compiles once,
+        as in the jax backend)."""
+        if design == "swr":
+            rng = np.random.default_rng(seed)
+            a, ma, ia = self._pack_partition(np.asarray(A), rng, "swor")
+            if self.kernel.two_sample:
+                b, mb, ib = self._pack_partition(np.asarray(B), rng, "swor")
+            else:
+                b, mb, ib = a, ma, ia
+            key = fold(root_key(seed), "incomplete")
+            return float(self._incomplete(
+                key, a, ma, ia, b, mb, ib, n_pairs=n_pairs))
+        if self.kernel.kind == "triplet":
             raise ValueError(
-                "the mesh backend samples within shards with replacement "
-                f"(design='swr'); got {design!r} — use backend='jax' or "
-                "'numpy' for swor/bernoulli designs"
+                "triplet incomplete sampling supports design='swr' only, "
+                f"got {design!r}"
             )
-        rng = np.random.default_rng(seed)
-        a, ma, ia = self._pack_partition(np.asarray(A), rng, "swor")
-        if self.kernel.two_sample:
-            b, mb, ib = self._pack_partition(np.asarray(B), rng, "swor")
-        else:
-            b, mb, ib = a, ma, ia
-        key = fold(root_key(seed), "incomplete")
-        return float(self._incomplete(
-            key, a, ma, ia, b, mb, ib, n_pairs=n_pairs))
+        from tuplewise_tpu.parallel.partition import draw_pair_design
+
+        A = np.asarray(A)
+        one_sample = not self.kernel.two_sample
+        Bv = A if B is None or not self.kernel.two_sample else np.asarray(B)
+        n1 = len(A)
+        n2 = n1 - 1 if one_sample else len(Bv)
+        i, j = draw_pair_design(
+            np.random.default_rng(seed), n1, n2, n_pairs, design,
+            one_sample=one_sample,
+        )
+        N = self.n_shards
+        size = len(i)
+        per = -(-size // N)
+        pad = N * per - size
+        w = np.concatenate([np.ones(size), np.zeros(pad)])
+        i = np.concatenate([i, np.zeros(pad, i.dtype)])
+        j = np.concatenate([j, np.zeros(pad, j.dtype)])
+        Ag = self._global(A)
+        Bg = Ag if Bv is A else self._global(Bv)
+        put = functools.partial(
+            jax.device_put, device=self._block_sharding
+        )
+        return float(self._designed(
+            Ag, Bg,
+            put(jnp.asarray(i.reshape(N, per), jnp.int32)),
+            put(jnp.asarray(j.reshape(N, per), jnp.int32)),
+            put(jnp.asarray(w.reshape(N, per), self.dtype)),
+        ))
 
     # ------------------------------------------------------------------ #
     def _two(self, A, B):
