@@ -165,3 +165,71 @@ class TestDropRenormalizeMesh:
         from tuplewise_tpu.parallel.mesh import make_mesh_2d
 
         assert check_mesh_health(make_mesh_2d(2, 4))
+
+
+class TestEndToEndFaultTolerance:
+    """run_with_fault_tolerance: probe -> dropped set -> estimator,
+    with no manual glue [VERDICT r1 next #8]."""
+
+    @needs_mesh
+    def test_healthy_mesh_no_drops(self, scores):
+        from tuplewise_tpu.parallel.faults import run_with_fault_tolerance
+
+        s1, s2 = scores
+        est = Estimator("auc", backend="mesh", n_workers=8,
+                        tile_a=64, tile_b=64)
+        v = run_with_fault_tolerance(est, "local", s1, s2, seed=0)
+        assert v == est.local_average(s1, s2, seed=0)
+
+    @needs_mesh
+    def test_injected_failure_survives(self, scores, monkeypatch):
+        """Simulate a dead chip: the collective probe reports unhealthy
+        and the per-device probe fails for worker 3. One call must
+        return the drop-and-renormalize value for dropped={3}."""
+        import tuplewise_tpu.parallel.faults as faults
+
+        s1, s2 = scores
+        est = Estimator("auc", backend="mesh", n_workers=8,
+                        tile_a=64, tile_b=64)
+        dead = est.backend.mesh.devices.flat[3]
+
+        monkeypatch.setattr(faults, "check_mesh_health", lambda mesh: False)
+        real_put = jax.device_put
+
+        def failing_put(x, dev=None, **kw):
+            if dev is dead:
+                raise RuntimeError("injected dead chip")
+            return real_put(x, dev, **kw)
+
+        monkeypatch.setattr(jax, "device_put", failing_put)
+        v = faults.run_with_fault_tolerance(
+            est, "repartitioned", s1, s2, n_rounds=2, seed=0
+        )
+        monkeypatch.undo()
+        want = est.repartitioned(s1, s2, n_rounds=2, seed=0,
+                                 dropped_workers=(3,))
+        assert v == want
+
+    @needs_mesh
+    def test_detect_dropped_workers_healthy(self):
+        from tuplewise_tpu.parallel.faults import detect_dropped_workers
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        assert detect_dropped_workers(make_mesh(8)) == ()
+
+    def test_rejects_complete_scheme(self, scores):
+        from tuplewise_tpu.parallel.faults import run_with_fault_tolerance
+
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy", n_workers=4)
+        with pytest.raises(ValueError, match="schemes"):
+            run_with_fault_tolerance(est, "complete", s1, s2)
+
+    def test_numpy_backend_detector_default(self, scores):
+        """Single-process backends default to a no-failure detector."""
+        from tuplewise_tpu.parallel.faults import run_with_fault_tolerance
+
+        s1, s2 = scores
+        est = Estimator("auc", backend="numpy", n_workers=4)
+        v = run_with_fault_tolerance(est, "local", s1, s2, seed=1)
+        assert v == est.local_average(s1, s2, seed=1)

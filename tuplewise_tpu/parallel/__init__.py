@@ -4,7 +4,9 @@
 # make_mesh, shard_axis_name` directly.
 from tuplewise_tpu.parallel.faults import (
     alive_mask,
+    detect_dropped_workers,
     normalize_dropped,
+    run_with_fault_tolerance,
     sample_failures,
     survivors,
 )
@@ -18,8 +20,10 @@ from tuplewise_tpu.parallel.partition import (
 
 __all__ = [
     "alive_mask",
+    "detect_dropped_workers",
     "draw_pair_design",
     "normalize_dropped",
+    "run_with_fault_tolerance",
     "partition_indices",
     "partition_two_sample",
     "pack_shards",

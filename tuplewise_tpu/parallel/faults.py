@@ -101,3 +101,77 @@ def check_mesh_health(mesh) -> bool:
         )
     )()
     return int(out) == n
+
+
+def detect_dropped_workers(mesh) -> Tuple[int, ...]:
+    """Map an unhealthy mesh to the set of dead workers.
+
+    Fast path: the collective ``check_mesh_health`` probe — healthy
+    means no per-device work at all. On failure (False, or the
+    collective itself raising, which is how a dead chip actually
+    surfaces), fall back to probing each device INDIVIDUALLY with a
+    tiny transfer+compute; devices that raise are the dropped set.
+    Raises if every device fails (nothing to renormalize over)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        if check_mesh_health(mesh):
+            return ()
+    except Exception:
+        pass  # collective died: fall through to per-device probing
+    dropped = []
+    for w, dev in enumerate(mesh.devices.flat):
+        try:
+            x = jax.device_put(jnp.ones(()), dev)
+            if float(x + 1) != 2.0:
+                dropped.append(w)
+        except Exception:
+            dropped.append(w)
+    n = mesh.devices.size
+    if len(dropped) >= n:
+        raise RuntimeError(
+            f"all {n} devices failed the health probe; cannot renormalize"
+        )
+    return tuple(dropped)
+
+
+def run_with_fault_tolerance(
+    estimator,
+    scheme: str,
+    A,
+    B=None,
+    *,
+    detector=None,
+    **kwargs,
+):
+    """Probe health -> derive the dropped set -> run the estimator, in
+    one call [SURVEY §5.4 end-to-end]: no manual glue between detection
+    and the drop-and-renormalize machinery.
+
+    scheme: "local" or "repartitioned" — the schemes whose per-worker
+    values stay individually unbiased under worker loss (complete /
+    incomplete statistics need every shard's data, so a dead worker is
+    not recoverable by renormalizing and the caller must re-pack).
+
+    detector: () -> dropped tuple; defaults to
+    ``detect_dropped_workers`` on the estimator's mesh (mesh backend)
+    or no-failures for single-process backends. kwargs pass through to
+    the estimator method (n_rounds, seed, scheme=partition scheme...).
+    """
+    methods = {"local": "local_average", "repartitioned": "repartitioned"}
+    if scheme not in methods:
+        raise ValueError(
+            f"fault tolerance applies to {sorted(methods)} schemes "
+            f"(per-worker values stay unbiased under loss); got {scheme!r}"
+        )
+    if detector is None:
+        mesh = getattr(estimator.backend, "mesh", None)
+        if mesh is not None:
+            detector = lambda: detect_dropped_workers(mesh)  # noqa: E731
+        else:
+            detector = tuple
+    dropped = normalize_dropped(detector(), estimator.n_workers)
+    return getattr(estimator, methods[scheme])(
+        A, B, dropped_workers=dropped, **kwargs
+    )
