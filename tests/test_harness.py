@@ -143,3 +143,50 @@ class TestCLIAndFigures:
 
         for p in (p1, p2, p3):
             assert os.path.getsize(p) > 1000
+
+
+class TestMeshMC:
+    """Mesh-native on-device Monte-Carlo [VERDICT r1 next #4]."""
+
+    def _needs_mesh(self):
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+
+    @pytest.mark.parametrize(
+        "scheme", ["complete", "local", "repartitioned", "incomplete"]
+    )
+    def test_unbiased_and_on_device(self, scheme):
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            backend="mesh", scheme=scheme, n_pos=512, n_neg=512,
+            n_workers=8, n_rounds=2, n_pairs=4096, n_reps=64,
+        )
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"], "mesh config fell back to the host loop"
+        assert abs(r["mean"] - true_gaussian_auc(1.0)) < (
+            5 * r["std_error"] + 1e-3
+        )
+
+    def test_variance_matches_jax_backend(self):
+        """Mesh-native MC must draw from the same estimate distribution
+        as the single-device vmapped path (same scheme semantics)."""
+        self._needs_mesh()
+        base = dict(scheme="local", n_pos=512, n_neg=512,
+                    n_workers=8, n_reps=300)
+        rm = run_variance_experiment(VarianceConfig(backend="mesh", **base))
+        rj = run_variance_experiment(VarianceConfig(backend="jax", **base))
+        # variance ratio CI: var estimates over M reps fluctuate ~sqrt(2/M)
+        ratio = rm["variance"] / rj["variance"]
+        assert 0.5 < ratio < 2.0, (rm["variance"], rj["variance"])
+
+    def test_fallback_when_not_divisible(self):
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            backend="mesh", scheme="complete", n_pos=515, n_neg=512,
+            n_workers=8, n_reps=8,
+        )
+        r = run_variance_experiment(cfg)  # host-loop fallback still works
+        assert not r["vmapped"]
+        assert abs(r["mean"] - true_gaussian_auc(1.0)) < 0.05

@@ -1,0 +1,153 @@
+"""On-device Monte-Carlo for mesh configs [SURVEY §7 "Variance harness
+cost"; VERDICT r1 next #4].
+
+The host-loop path re-generates and re-packs data per repetition —
+at n=10^7 the M-rep headline experiment would spend most of its
+wall-clock off-device, contaminating the variance-vs-wallclock curve.
+This runner keeps the WHOLE Monte-Carlo loop in one jitted program over
+the mesh:
+
+* data generation is itself distributed: each shard draws its own
+  Gaussian score block from a per-(rep, shard) folded key — synthetic
+  i.i.d. data needs no packing and no host↔device transfer at all;
+* local / repartitioned rounds reshuffle ON DEVICE exactly like
+  MeshBackend.one_round: a fresh permutation per round regathers the
+  sharded global array into worker blocks (XLA inserts the all-to-all);
+* complete statistics run the ppermute ring; incomplete samples within
+  shards;
+* reps run under `lax.map`, so M reps cost M compiled iterations with
+  zero host round-trips in between.
+
+Statistical contract: estimates are drawn from the SAME distribution as
+looping the public mesh Estimator with fresh data per rep (generation,
+partitioning, and estimator semantics are identical); the fold chains
+differ, so individual values are not bit-equal to any host-loop run —
+the variance harness only consumes the distribution.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tuplewise_tpu.ops.kernels import get_kernel
+
+
+def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
+    """Compiled rep-array -> estimate-array runner for diff kernels on
+    Gaussian scores over a 1-D device mesh, or None when this config
+    can't run fully on device (feature/triplet kernels, shard counts
+    that don't divide n — the harness falls back to the host loop).
+    """
+    kernel = get_kernel(cfg.kernel)
+    if kernel.kind != "diff" or not kernel.two_sample:
+        return None
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tuplewise_tpu.ops import pair_tiles
+    from tuplewise_tpu.parallel import ring
+    from tuplewise_tpu.parallel.device_partition import draw_blocks
+    from tuplewise_tpu.parallel.mesh import make_mesh
+    from tuplewise_tpu.utils.rng import fold, root_key
+
+    if mesh is None:
+        mesh = make_mesh(cfg.n_workers)
+    N = int(np.prod(mesh.devices.shape))
+    if len(mesh.axis_names) != 1:
+        return None  # harness sweeps 1-D worker counts
+    n1, n2 = cfg.n_pos, cfg.n_neg
+    if n1 % N or n2 % N:
+        return None
+    m1, m2 = n1 // N, n2 // N
+    axis = mesh.axis_names[0]
+    PA = P(axis)
+    shard2 = NamedSharding(mesh, PA)
+    tile_a, tile_b = min(tile, m1), min(tile, m2)
+
+    # ---- per-shard data generation (no packing, no transfer) --------- #
+    def gen_body(key):
+        w = lax.axis_index(axis)
+        k1, k2 = jax.random.split(fold(key, "shard", w))
+        s1 = jax.random.normal(k1, (1, m1), jnp.float32) + cfg.separation
+        s2 = jax.random.normal(k2, (1, m2), jnp.float32)
+        return s1, s2
+
+    gen = jax.shard_map(
+        gen_body, mesh=mesh, in_specs=P(), out_specs=(PA, PA),
+        check_vma=False,
+    )
+
+    # ---- estimator bodies (mirror backends.mesh_backend) ------------- #
+    def complete_body(a, b):
+        s, c = ring.ring_pair_stats(
+            kernel, a[0], b[0], axis_name=axis,
+            tile_a=tile_a, tile_b=tile_b,
+        )
+        return s / c
+
+    complete_smap = jax.shard_map(
+        complete_body, mesh=mesh, in_specs=(PA, PA), out_specs=P(),
+        check_vma=False,
+    )
+
+    def local_mean_body(a, b):
+        s, c = pair_tiles.pair_stats(
+            kernel, a[0], b[0], tile_a=tile_a, tile_b=tile_b
+        )
+        return (s / c)[None]
+
+    local_mean_smap = jax.shard_map(
+        local_mean_body, mesh=mesh, in_specs=(PA, PA), out_specs=PA,
+        check_vma=False,
+    )
+
+    def one_round(s1, s2, key):
+        """On-device reshuffle + per-worker local means (the all-to-all
+        regather of MeshBackend.one_round, minus fault plumbing)."""
+        k1, k2 = jax.random.split(key)
+        i1 = draw_blocks(k1, n1, N, cfg.partition_scheme)
+        i2 = draw_blocks(k2, n2, N, cfg.partition_scheme)
+        Ab = s1.reshape(n1).at[i1].get(out_sharding=shard2)
+        Bb = s2.reshape(n2).at[i2].get(out_sharding=shard2)
+        return jnp.mean(local_mean_smap(Ab, Bb))
+
+    def incomplete_body(key, a, b):
+        w = lax.axis_index(axis)
+        kk = fold(key, "shard", w)
+        per = -(-cfg.n_pairs // N)
+        i, j = pair_tiles.sample_pair_indices(kk, m1, m2, per, False)
+        vals = kernel.pair_elementwise(a[0, i], b[0, j], jnp)
+        return lax.pmean(jnp.mean(vals, dtype=a.dtype), axis)
+
+    incomplete_smap = jax.shard_map(
+        incomplete_body, mesh=mesh, in_specs=(P(), PA, PA), out_specs=P(),
+        check_vma=False,
+    )
+
+    def one_rep(rep):
+        key = fold(root_key(cfg.seed), "mc_rep", rep)
+        s1, s2 = gen(fold(key, "data"))
+        if cfg.scheme == "complete":
+            return complete_smap(s1, s2)
+        if cfg.scheme == "local":
+            return one_round(s1, s2, fold(key, "partition"))
+        if cfg.scheme == "repartitioned":
+            def body(carry, t):
+                return carry + one_round(
+                    s1, s2, fold(key, "partition", t)
+                ), None
+
+            total, _ = lax.scan(
+                body, jnp.zeros((), jnp.float32), jnp.arange(cfg.n_rounds)
+            )
+            return total / cfg.n_rounds
+        if cfg.scheme == "incomplete":
+            return incomplete_smap(fold(key, "pairs"), s1, s2)
+        raise ValueError(cfg.scheme)
+
+    # lax.map (not vmap): each rep already fills the mesh; serializing
+    # reps bounds live memory at one rep's working set
+    return jax.jit(lambda reps: lax.map(one_rep, reps))

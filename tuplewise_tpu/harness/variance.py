@@ -77,10 +77,16 @@ def _estimate_once(est: Estimator, cfg: VarianceConfig, rep: int) -> float:
 def _make_vmapped_runner(cfg: VarianceConfig):
     """Compiled rep-array -> estimate-array runner for diff kernels on
     Gaussian scores (one XLA program for the whole Monte-Carlo batch),
-    or None if this config isn't vmappable (feature kernels, non-jax
-    backends, mesh execution). Estimates depend only on the ABSOLUTE rep
-    indices passed in, so callers may chunk the rep range freely
-    (checkpoint/resume) without changing any value."""
+    or None if this config isn't compilable end-to-end (feature
+    kernels, the numpy oracle backend). Mesh configs get the
+    mesh-native runner (harness.mesh_mc): generation, reshuffling, and
+    estimation all stay on device across reps. Estimates depend only on
+    the ABSOLUTE rep indices passed in, so callers may chunk the rep
+    range freely (checkpoint/resume) without changing any value."""
+    if cfg.backend == "mesh":
+        from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
+
+        return make_mesh_mc_runner(cfg)
     if cfg.backend != "jax" or get_kernel(cfg.kernel).kind != "diff":
         return None
 
