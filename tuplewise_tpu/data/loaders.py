@@ -6,19 +6,24 @@ embeddings (degree-3 triplet kernels) [SURVEY §3 "Dataset loaders"].
 This environment has **zero network egress**, so each loader:
 
 1. first looks for a real on-disk copy (``path=`` argument or
-   ``TUPLEWISE_DATA_DIR``), and
+   ``TUPLEWISE_DATA_DIR``) — either a pre-converted ``.npz`` blob OR the
+   CANONICAL raw distribution files (``adult.data`` CSV for UCI Adult;
+   ``train-images-idx3-ubyte[.gz]`` / ``train-labels-idx1-ubyte[.gz]``
+   for MNIST, embedded via a deterministic PCA projection), and
 2. otherwise falls back to a *deterministic synthetic surrogate* with the
    same schema/shape statistics, clearly marked via the returned
    ``meta["synthetic"]`` flag.
 
 The surrogate keeps every downstream code path (loaders -> partitioner ->
-estimators -> learner) runnable and testable; swapping in the real files
-requires no code change.
+estimators -> learner) runnable and testable; dropping the real files
+into ``TUPLEWISE_DATA_DIR`` requires no code change.
 """
 
 from __future__ import annotations
 
+import gzip
 import os
+import struct
 from typing import Optional, Tuple
 
 import numpy as np
@@ -27,9 +32,104 @@ _ADULT_DIM = 14  # UCI Adult: 6 continuous + 8 categorical attributes
 _MNIST_EMB_DIM = 32
 _MNIST_CLASSES = 10
 
+# adult.data column schema (UCI census-income): position -> continuous?
+_ADULT_CONTINUOUS = (0, 2, 4, 10, 11, 12)   # age, fnlwgt, education-num,
+#                                             capital-gain/loss, hours/week
+_ADULT_N_COLS = 15                           # 14 attributes + label
+
 
 def _data_dir() -> str:
     return os.environ.get("TUPLEWISE_DATA_DIR", os.path.join(os.path.dirname(__file__), "_cache"))
+
+
+def parse_adult_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse the canonical ``adult.data`` / ``adult.test`` CSV.
+
+    Schema: 6 continuous + 8 categorical attributes, comma-separated
+    with a ``<=50K`` / ``>50K`` label (trailing '.' in adult.test).
+    Rows containing missing values ('?') are dropped — the standard
+    preprocessing for this dataset. Categoricals are one-hot encoded
+    with a DETERMINISTIC column order (sorted category strings), so the
+    same file always yields the same design matrix.
+
+    Returns (X [n, d] float64 un-standardized, y [n] int {0, 1}).
+    """
+    rows = []
+    with open(path) as f:
+        for line in f:
+            parts = [p.strip() for p in line.strip().rstrip(".").split(",")]
+            if len(parts) != _ADULT_N_COLS or "?" in parts:
+                continue
+            rows.append(parts)
+    if not rows:
+        raise ValueError(f"no parseable rows in {path!r}")
+    cols = list(zip(*rows))
+    blocks, names = [], []
+    for c in range(_ADULT_N_COLS - 1):
+        if c in _ADULT_CONTINUOUS:
+            blocks.append(np.asarray(cols[c], float)[:, None])
+            names.append(f"col{c}")
+        else:
+            cats = sorted(set(cols[c]))
+            code = {v: k for k, v in enumerate(cats)}
+            idx = np.asarray([code[v] for v in cols[c]])
+            onehot = np.zeros((len(idx), len(cats)))
+            onehot[np.arange(len(idx)), idx] = 1.0
+            blocks.append(onehot)
+            names.extend(f"col{c}={v}" for v in cats)
+    X = np.concatenate(blocks, axis=1)
+    y = np.asarray([1 if v.startswith(">50K") else 0 for v in cols[-1]])
+    return X, y
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an IDX-format file (the canonical MNIST distribution
+    format), transparently gunzipping ``.gz``. Magic: 2 zero bytes,
+    dtype code (0x08 = uint8), ndim, then ndim big-endian u32 dims."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0 or dtype_code != 0x08:
+            raise ValueError(
+                f"{path!r} is not a uint8 IDX file "
+                f"(magic {zero:#x}/{dtype_code:#x})"
+            )
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    if data.size != int(np.prod(dims)):
+        raise ValueError(
+            f"{path!r}: payload {data.size} != header dims {dims}"
+        )
+    return data.reshape(dims)
+
+
+def _find_idx_pair(dirs) -> Optional[Tuple[str, str]]:
+    for d in dirs:
+        for suffix in ("", ".gz"):
+            imgs = os.path.join(d, f"train-images-idx3-ubyte{suffix}")
+            labs = os.path.join(d, f"train-labels-idx1-ubyte{suffix}")
+            if os.path.exists(imgs) and os.path.exists(labs):
+                return imgs, labs
+    return None
+
+
+def mnist_pca_embeddings(
+    images: np.ndarray, dim: int = _MNIST_EMB_DIM
+) -> np.ndarray:
+    """Deterministic PCA embedding of raw [n, 28, 28] uint8 images:
+    center, project onto the top ``dim`` eigenvectors of the pixel
+    covariance (sign-fixed so the result is reproducible across BLAS
+    implementations), scale to unit average norm."""
+    flat = images.reshape(len(images), -1).astype(np.float64) / 255.0
+    mu = flat.mean(axis=0)
+    centered = flat - mu
+    cov = centered.T @ centered / len(flat)
+    vals, vecs = np.linalg.eigh(cov)
+    top = vecs[:, np.argsort(vals)[::-1][:dim]]
+    # sign convention: largest-|component| entry of each PC is positive
+    signs = np.sign(top[np.argmax(np.abs(top), axis=0), np.arange(dim)])
+    E = centered @ (top * signs)
+    return E / (np.linalg.norm(E, axis=1).mean() + 1e-12)
 
 
 def load_adult(
@@ -39,23 +139,33 @@ def load_adult(
 ) -> Tuple[np.ndarray, np.ndarray, dict]:
     """UCI Adult as a binary task: features, labels in {0, 1}.
 
-    Returns (X [n, d] float64 standardized, y [n] int, meta). If no real
-    ``adult.npz`` is found (keys ``X``, ``y``), generates a deterministic
-    surrogate: a mixture where the positive class (~24%, the real Adult
-    positive rate) is shifted along a random direction with heterogeneous
-    per-feature scales — enough structure for ranking experiments.
+    Returns (X [n, d] float64 standardized, y [n] int, meta). Real-data
+    resolution order: ``path=`` (either format) -> ``adult.npz`` (keys
+    ``X``, ``y``) -> the canonical ``adult.data``/``adult.csv`` CSV
+    parsed by :func:`parse_adult_csv`. With nothing on disk, generates
+    a deterministic surrogate: a mixture where the positive class
+    (~24%, the real Adult positive rate) is shifted along a random
+    direction with heterogeneous per-feature scales — enough structure
+    for ranking experiments.
     """
     candidates = [path] if path else []
-    candidates.append(os.path.join(_data_dir(), "adult.npz"))
+    candidates += [
+        os.path.join(_data_dir(), f)
+        for f in ("adult.npz", "adult.data", "adult.csv")
+    ]
     for c in candidates:
-        if c and os.path.exists(c):
+        if not (c and os.path.exists(c)):
+            continue
+        if c.endswith(".npz"):
             blob = np.load(c)
             X, y = np.asarray(blob["X"], float), np.asarray(blob["y"], int)
-            if len(X) > n:  # honor the requested size on real data too
-                keep = np.random.default_rng(seed).choice(len(X), n, replace=False)
-                X, y = X[keep], y[keep]
-            X = (X - X.mean(0)) / (X.std(0) + 1e-12)
-            return X, y, {"synthetic": False, "source": c}
+        else:
+            X, y = parse_adult_csv(c)
+        if len(X) > n:  # honor the requested size on real data too
+            keep = np.random.default_rng(seed).choice(len(X), n, replace=False)
+            X, y = X[keep], y[keep]
+        X = (X - X.mean(0)) / (X.std(0) + 1e-12)
+        return X, y, {"synthetic": False, "source": c}
 
     rng = np.random.default_rng(seed + 1043)
     d = _ADULT_DIM
@@ -80,11 +190,15 @@ def load_mnist_embeddings(
 ) -> Tuple[np.ndarray, np.ndarray, dict]:
     """MNIST embeddings for triplet metric-learning statistics.
 
-    Returns (E [n, dim] float64, labels [n] int in [0, 10), meta). If no
-    real ``mnist_embeddings.npz`` (keys ``E``, ``labels``) is found,
-    generates class-clustered unit-scale embeddings: 10 well-separated
-    class centroids with intra-class spread, mimicking a trained
-    embedding's geometry.
+    Returns (E [n, dim] float64, labels [n] int in [0, 10), meta).
+    Real-data resolution order: ``path=`` npz -> ``mnist_embeddings.npz``
+    (keys ``E``, ``labels``) -> the canonical raw IDX pair
+    ``train-images-idx3-ubyte[.gz]`` / ``train-labels-idx1-ubyte[.gz]``,
+    embedded with the deterministic PCA projection
+    (:func:`mnist_pca_embeddings`). With nothing on disk, generates
+    class-clustered unit-scale embeddings: 10 well-separated class
+    centroids with intra-class spread, mimicking a trained embedding's
+    geometry.
     """
     candidates = [path] if path else []
     candidates.append(os.path.join(_data_dir(), "mnist_embeddings.npz"))
@@ -97,6 +211,24 @@ def load_mnist_embeddings(
                 keep = np.random.default_rng(seed).choice(len(E), n, replace=False)
                 E, labels = E[keep], labels[keep]
             return E, labels, {"synthetic": False, "source": c}
+
+    idx = _find_idx_pair([_data_dir()])
+    if idx is not None:
+        imgs, labs = idx
+        images = _read_idx(imgs)
+        labels = _read_idx(labs).astype(int)
+        if images.ndim != 3 or len(images) != len(labels):
+            raise ValueError(
+                f"IDX pair mismatch: images {images.shape}, "
+                f"labels {labels.shape}"
+            )
+        if len(images) > n:
+            keep = np.random.default_rng(seed).choice(
+                len(images), n, replace=False
+            )
+            images, labels = images[keep], labels[keep]
+        E = mnist_pca_embeddings(images, dim=min(dim, images[0].size))
+        return E, labels, {"synthetic": False, "source": imgs}
 
     rng = np.random.default_rng(seed + 60283)
     centroids = rng.standard_normal((_MNIST_CLASSES, dim)) * 2.0
