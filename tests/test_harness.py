@@ -1,6 +1,7 @@
 """L4/L6 harness: vmapped Monte-Carlo correctness, trade-off shapes,
 CLI, figures, triplet experiment."""
 
+import dataclasses
 import json
 import subprocess
 import sys
@@ -76,6 +77,26 @@ class TestVarianceExperiment:
             z[2] - two_sample_variance_from_zetas(z, 512, 512)
         ) / 500
         assert abs(r["variance"] - pred) / pred < 0.35
+
+    def test_pallas_branch_interpret_parity(self, monkeypatch):
+        """TUPLEWISE_HARNESS_PALLAS=interpret exercises the TPU-only
+        Pallas routing of the vmapped runner on CPU: same estimates as
+        the XLA scan path to float32 tolerance."""
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "off")
+        cfg = VarianceConfig(n_pos=300, n_neg=260, n_workers=4, n_reps=4)
+        xla = run_variance_experiment(cfg)
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "interpret")
+        pal = run_variance_experiment(cfg)
+        assert pal["vmapped"] and xla["vmapped"]
+        assert abs(pal["mean"] - xla["mean"]) < 1e-6
+        loc = run_variance_experiment(
+            dataclasses.replace(cfg, scheme="local")
+        )
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "off")
+        loc_xla = run_variance_experiment(
+            dataclasses.replace(cfg, scheme="local")
+        )
+        assert abs(loc["mean"] - loc_xla["mean"]) < 1e-6
 
     def test_numpy_backend_loop_path(self):
         cfg = VarianceConfig(

@@ -61,9 +61,11 @@ class TestAdultCSV:
         n = _write_adult(p)
         X, y = parse_adult_csv(str(p))
         assert len(X) == n            # '?' row and malformed row dropped
-        # 6 continuous + one-hot blocks for the 8 categoricals
-        n_cats = 1 + 2 + 1 + 1 + 1 + 1 + 2 + 1  # distinct values per cat col
-        assert X.shape[1] == 6 + n_cats
+        # 6 continuous + one-hot blocks over the CANONICAL UCI category
+        # sets (fixture values are all canonical, so the full vocabulary
+        # applies): workclass 8, education 16, marital 7, occupation 14,
+        # relationship 6, race 5, sex 2, native-country 41
+        assert X.shape[1] == 6 + (8 + 16 + 7 + 14 + 6 + 5 + 2 + 41)
         assert set(y) == {0, 1}
         # each of the 8 categorical columns contributes exactly one
         # indicator 1 per row (no continuous value is 1.0 in the fixture)
@@ -71,6 +73,51 @@ class TestAdultCSV:
         # deterministic encoding: same file -> identical matrix
         X2, _ = parse_adult_csv(str(p))
         assert np.array_equal(X, X2)
+
+    def test_train_test_alignment(self, tmp_path):
+        """adult.data/adult.test stay column-aligned even when a
+        category ('Holand-Netherlands') appears in only one file."""
+        a, b = tmp_path / "adult.data", tmp_path / "adult.test"
+        row = _ADULT_ROW.replace("United-States", "{country}")
+        a.write_text("\n".join([
+            row.format(age=30, work="Private", sex="Male", hours=40,
+                       label="<=50K", country="Holand-Netherlands"),
+            row.format(age=40, work="Private", sex="Male", hours=40,
+                       label=">50K", country="United-States"),
+        ]) + "\n")
+        b.write_text(row.format(
+            age=40, work="Private", sex="Male", hours=40,
+            label=">50K.", country="United-States",
+        ) + "\n")
+        Xa, _ = parse_adult_csv(str(a))
+        Xb, _ = parse_adult_csv(str(b))
+        assert Xa.shape[1] == Xb.shape[1]
+        # the shared United-States rows encode identically across files
+        assert np.array_equal(Xa[1], Xb[0])
+
+    def test_noncanonical_category_falls_back(self, tmp_path):
+        """A column with out-of-vocabulary values gets a file-local
+        sorted vocabulary (with a warning) instead of crashing."""
+        p = tmp_path / "adult.data"
+        p.write_text("\n".join([
+            _ADULT_ROW.format(age=30, work="Gig-economy", sex="Male",
+                              hours=40, label="<=50K"),
+            _ADULT_ROW.format(age=40, work="Artisan", sex="Female",
+                              hours=30, label=">50K"),
+        ]) + "\n")
+        with pytest.warns(UserWarning, match="non-canonical"):
+            X, y = parse_adult_csv(str(p))
+        assert len(X) == 2
+        # workclass block is file-local (2 cols); sex stays canonical
+        assert X.shape[1] == 6 + (2 + 16 + 7 + 14 + 6 + 5 + 2 + 41)
+
+    def test_truncated_idx_raises_valueerror(self, tmp_path):
+        p = tmp_path / "train-images-idx3-ubyte"
+        p.write_bytes(b"\x00\x00")  # 2 bytes: not even a full magic
+        from tuplewise_tpu.data.loaders import _read_idx
+
+        with pytest.raises(ValueError, match="IDX"):
+            _read_idx(str(p))
 
     def test_adult_test_trailing_dot(self, tmp_path):
         p = tmp_path / "adult.data"

@@ -37,6 +37,54 @@ _ADULT_CONTINUOUS = (0, 2, 4, 10, 11, 12)   # age, fnlwgt, education-num,
 #                                             capital-gain/loss, hours/week
 _ADULT_N_COLS = 15                           # 14 attributes + label
 
+# Canonical category sets per the UCI adult.names spec, sorted. Encoding
+# against the FULL canonical vocabulary (not the categories that happen
+# to appear in one file) keeps the design matrix aligned across
+# adult.data and adult.test — e.g. 'Holand-Netherlands' occurs once in
+# adult.data and never in adult.test; a per-file vocabulary would shift
+# every later one-hot column between the two.
+_ADULT_CATEGORIES = {
+    1: (  # workclass
+        "Federal-gov", "Local-gov", "Never-worked", "Private",
+        "Self-emp-inc", "Self-emp-not-inc", "State-gov", "Without-pay",
+    ),
+    3: (  # education
+        "10th", "11th", "12th", "1st-4th", "5th-6th", "7th-8th", "9th",
+        "Assoc-acdm", "Assoc-voc", "Bachelors", "Doctorate", "HS-grad",
+        "Masters", "Preschool", "Prof-school", "Some-college",
+    ),
+    5: (  # marital-status
+        "Divorced", "Married-AF-spouse", "Married-civ-spouse",
+        "Married-spouse-absent", "Never-married", "Separated", "Widowed",
+    ),
+    6: (  # occupation
+        "Adm-clerical", "Armed-Forces", "Craft-repair", "Exec-managerial",
+        "Farming-fishing", "Handlers-cleaners", "Machine-op-inspct",
+        "Other-service", "Priv-house-serv", "Prof-specialty",
+        "Protective-serv", "Sales", "Tech-support", "Transport-moving",
+    ),
+    7: (  # relationship
+        "Husband", "Not-in-family", "Other-relative", "Own-child",
+        "Unmarried", "Wife",
+    ),
+    8: (  # race
+        "Amer-Indian-Eskimo", "Asian-Pac-Islander", "Black", "Other",
+        "White",
+    ),
+    9: ("Female", "Male"),  # sex
+    13: (  # native-country
+        "Cambodia", "Canada", "China", "Columbia", "Cuba",
+        "Dominican-Republic", "Ecuador", "El-Salvador", "England",
+        "France", "Germany", "Greece", "Guatemala", "Haiti",
+        "Holand-Netherlands", "Honduras", "Hong", "Hungary", "India",
+        "Iran", "Ireland", "Italy", "Jamaica", "Japan", "Laos", "Mexico",
+        "Nicaragua", "Outlying-US(Guam-USVI-etc)", "Peru", "Philippines",
+        "Poland", "Portugal", "Puerto-Rico", "Scotland", "South",
+        "Taiwan", "Thailand", "Trinadad&Tobago", "United-States",
+        "Vietnam", "Yugoslavia",
+    ),
+}
+
 
 def _data_dir() -> str:
     return os.environ.get("TUPLEWISE_DATA_DIR", os.path.join(os.path.dirname(__file__), "_cache"))
@@ -49,8 +97,11 @@ def parse_adult_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
     with a ``<=50K`` / ``>50K`` label (trailing '.' in adult.test).
     Rows containing missing values ('?') are dropped — the standard
     preprocessing for this dataset. Categoricals are one-hot encoded
-    with a DETERMINISTIC column order (sorted category strings), so the
-    same file always yields the same design matrix.
+    against the CANONICAL UCI vocabulary (``_ADULT_CATEGORIES``), so
+    adult.data and adult.test yield identically laid-out design
+    matrices even though some categories appear in only one file. A
+    column whose values fall outside the canonical set (toy fixtures)
+    falls back to that file's own sorted categories.
 
     Returns (X [n, d] float64 un-standardized, y [n] int {0, 1}).
     """
@@ -64,19 +115,34 @@ def parse_adult_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
     if not rows:
         raise ValueError(f"no parseable rows in {path!r}")
     cols = list(zip(*rows))
-    blocks, names = [], []
+    blocks = []
     for c in range(_ADULT_N_COLS - 1):
         if c in _ADULT_CONTINUOUS:
             blocks.append(np.asarray(cols[c], float)[:, None])
-            names.append(f"col{c}")
         else:
-            cats = sorted(set(cols[c]))
+            seen = set(cols[c])
+            canon = _ADULT_CATEGORIES[c]
+            if seen <= set(canon):
+                cats = canon
+            else:
+                # out-of-vocabulary values: this file gets its OWN
+                # vocabulary for the column, which breaks alignment
+                # with any canonically-encoded file — say so loudly.
+                import warnings
+
+                warnings.warn(
+                    f"{path!r} column {c}: non-canonical categories "
+                    f"{sorted(seen - set(canon))!r}; using a file-local "
+                    f"vocabulary (design matrix will NOT align with "
+                    f"canonically-encoded adult files)",
+                    stacklevel=2,
+                )
+                cats = tuple(sorted(seen))
             code = {v: k for k, v in enumerate(cats)}
             idx = np.asarray([code[v] for v in cols[c]])
             onehot = np.zeros((len(idx), len(cats)))
             onehot[np.arange(len(idx)), idx] = 1.0
             blocks.append(onehot)
-            names.extend(f"col{c}={v}" for v in cats)
     X = np.concatenate(blocks, axis=1)
     y = np.asarray([1 if v.startswith(">50K") else 0 for v in cols[-1]])
     return X, y
@@ -87,14 +153,24 @@ def _read_idx(path: str) -> np.ndarray:
     format), transparently gunzipping ``.gz``. Magic: 2 zero bytes,
     dtype code (0x08 = uint8), ndim, then ndim big-endian u32 dims."""
     opener = gzip.open if path.endswith(".gz") else open
+
+    def read_exact(f, k):
+        buf = f.read(k)
+        if len(buf) != k:  # truncated copy — keep the ValueError contract
+            raise ValueError(
+                f"{path!r}: truncated IDX header "
+                f"(wanted {k} bytes, got {len(buf)})"
+            )
+        return buf
+
     with opener(path, "rb") as f:
-        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        zero, dtype_code, ndim = struct.unpack(">HBB", read_exact(f, 4))
         if zero != 0 or dtype_code != 0x08:
             raise ValueError(
                 f"{path!r} is not a uint8 IDX file "
                 f"(magic {zero:#x}/{dtype_code:#x})"
             )
-        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        dims = struct.unpack(f">{ndim}I", read_exact(f, 4 * ndim))
         data = np.frombuffer(f.read(), dtype=np.uint8)
     if data.size != int(np.prod(dims)):
         raise ValueError(
