@@ -1,0 +1,260 @@
+"""North-star experiment driver [BASELINE.json:2,5; VERDICT r1 next #1].
+
+Runs the paper-shaped suite on the attached TPU chip and writes every
+artifact the trade-off figures need:
+
+  results/variance_n1e6.jsonl   complete/local at n=10^6 (M=200)
+  results/rounds_n1e6.jsonl     repartitioned T in {1,2,4,8,16} (M=200)
+  results/pairs_n1e6.jsonl      incomplete B in {1e3..1e7}     (M=200)
+  results/variance_n1e7.jsonl   complete/local at n=10^7 (M=32)
+  results/rounds_n1e7.jsonl     repartitioned T in {1,2,4,8}  (M=16)
+  results/pairs_n1e7.jsonl      incomplete B in {1e3..1e7}    (M=64)
+  results/mesh_n1e6.jsonl       mesh backend (ring path), mesh of 1
+  results/figures/*.png         the three paper-shaped figures
+
+"n" is the TOTAL sample size (n_pos = n_neg = n/2), matching the
+paper's usage; the complete grid at n=10^7 is 2.5e13 pairs per rep.
+Wall-clocks recorded by the harness are compute-only (compile excluded)
+— what the variance-vs-wallclock axis needs. Chunked execution
+(checkpoint_every) bounds HBM and amortizes the one warm-up chunk.
+
+Usage: python scripts/northstar.py [--quick]   (--quick: tiny sanity run)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+RESULTS = os.path.join(REPO, "results")
+
+from tuplewise_tpu.harness.variance import (  # noqa: E402
+    VarianceConfig, run_variance_experiment, write_jsonl,
+)
+
+
+def log(msg):
+    print(f"[northstar +{time.perf_counter() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+T0 = time.perf_counter()
+
+
+_touched = set()
+
+
+def run(cfg, out, chunk=None, trace_dir=None):
+    path = os.path.join(RESULTS, out)
+    # write_jsonl appends; truncate each output once per invocation so
+    # re-running a stage (e.g. after a crash) never duplicates rows
+    if path not in _touched:
+        _touched.add(path)
+        if os.path.exists(path):
+            os.remove(path)
+    r = run_variance_experiment(
+        cfg, checkpoint_every=chunk, trace_dir=trace_dir
+    )
+    write_jsonl([r], path)
+    log(f"{out}: scheme={cfg.scheme} T={cfg.n_rounds} B={cfg.n_pairs} "
+        f"var={r['variance']:.3e} wc={r['wallclock_s']:.1f}s")
+    return r
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--stages", type=str, default="1e6,1e7,mesh,figs",
+                    help="comma list of stages to run")
+    args = ap.parse_args()
+    stages = set(args.stages.split(","))
+    os.makedirs(RESULTS, exist_ok=True)
+    os.makedirs(os.path.join(RESULTS, "figures"), exist_ok=True)
+
+    q = args.quick
+    # n here is PER CLASS: n_pos = n_neg = n/2 of the total sample size
+    n6 = 1_000 if q else 500_000          # "n = 10^6"
+    n7 = 2_000 if q else 5_000_000        # "n = 10^7"
+    m6 = 8 if q else 200
+    m7 = 4 if q else 32
+    m7r = 4 if q else 16
+
+    base6 = VarianceConfig(n_pos=n6, n_neg=n6, n_workers=8, n_reps=m6)
+    base7 = VarianceConfig(n_pos=n7, n_neg=n7, n_workers=8, n_reps=m7)
+
+    if "1e6" in stages:
+        log(f"== stage n=1e6 (n_pos=n_neg={n6}, M={m6}) ==")
+        run(base6, "variance_n1e6.jsonl", chunk=None if q else 8)
+        run(dataclasses.replace(base6, scheme="local"),
+            "variance_n1e6.jsonl", chunk=None if q else 8)
+        for T in (1, 2, 4, 8, 16):
+            run(dataclasses.replace(
+                    base6, scheme="repartitioned", n_rounds=T),
+                "rounds_n1e6.jsonl", chunk=None if q else 8)
+        for B in (1_000, 10_000, 100_000, 1_000_000, 10_000_000):
+            if q and B > 100_000:
+                continue
+            run(dataclasses.replace(base6, scheme="incomplete", n_pairs=B),
+                "pairs_n1e6.jsonl", chunk=None if q else 25)
+
+    if "1e7" in stages:
+        log(f"== stage n=1e7 (n_pos=n_neg={n7}, M={m7}) ==")
+        run(base7, "variance_n1e7.jsonl", chunk=None if q else 1)
+        run(dataclasses.replace(base7, scheme="local"),
+            "variance_n1e7.jsonl", chunk=None if q else 1)
+        for T in (1, 2, 4, 8):
+            run(dataclasses.replace(
+                    base7, scheme="repartitioned", n_rounds=T,
+                    n_reps=m7r),
+                "rounds_n1e7.jsonl", chunk=None if q else 1)
+        for B in (1_000, 10_000, 100_000, 1_000_000, 10_000_000):
+            if q and B > 100_000:
+                continue
+            run(dataclasses.replace(
+                    base7, scheme="incomplete", n_pairs=B,
+                    n_reps=4 if q else 64),
+                "pairs_n1e7.jsonl", chunk=None if q else 8)
+
+    if "tradeoff" in stages:
+        # The paper's VISIBLE trade-off regime: many workers, small
+        # per-worker blocks (the local-average deficit ~ zeta_11/(n*m)
+        # needs m in the tens). N sweep for the local estimator, T
+        # sweeps at the two smallest block sizes, plus the closed-form
+        # Hoeffding prediction for the overlay [SURVEY §1.2, §5.1].
+        mt = 8 if q else 800
+        baset = dataclasses.replace(base6, n_reps=mt)
+        log(f"== stage tradeoff (n_pos=n_neg={n6}, M={mt}) ==")
+        comp = run(baset, "tradeoff_complete.jsonl",
+                   chunk=None if q else 8)
+        n_sweep = (2, 4) if q else (8, 100, 1000, 12500, 125000, 250000)
+        for N in n_sweep:
+            run(dataclasses.replace(baset, scheme="local", n_workers=N),
+                "tradeoff_workers.jsonl", chunk=None if q else 8)
+        for N in ((4,) if q else (125000, 250000)):
+            for T in (1, 2) if q else (1, 2, 4, 8, 16, 32):
+                run(dataclasses.replace(
+                        baset, scheme="repartitioned", n_workers=N,
+                        n_rounds=T),
+                    f"tradeoff_rounds_N{N}.jsonl", chunk=None if q else 25)
+        # plug-in zetas on a 20k sample -> closed-form overlay curves
+        from tuplewise_tpu.data import make_gaussians
+        from tuplewise_tpu.estimators.variance import (
+            two_sample_variance_from_zetas, two_sample_zetas,
+        )
+
+        Xz, Yz = make_gaussians(20_000, 20_000, 1, 1.0, seed=7)
+        zetas = two_sample_zetas("auc", Xz[:, 0], Yz[:, 0])
+        vc = two_sample_variance_from_zetas(zetas, n6, n6)
+
+        def v_loc(N):
+            return two_sample_variance_from_zetas(
+                zetas, n6 // N, n6 // N) / N
+
+        theory = {
+            "zetas": list(zetas),
+            "complete": vc,
+            "workers": [[int(N), v_loc(N)] for N in n_sweep],
+            "rounds": {
+                str(N): [[T, vc + max(v_loc(N) - vc, 0.0) / T]
+                         for T in (1, 2, 4, 8, 16, 32)]
+                for N in ((4,) if q else (125000, 250000))
+            },
+        }
+        with open(os.path.join(RESULTS, "tradeoff_theory.json"), "w") as f:
+            json.dump(theory, f, indent=1)
+        log("tradeoff stage done (theory overlay written)")
+
+    if "mesh" in stages:
+        # the DISTRIBUTED estimator on the real chip: mesh of 1, ring
+        # hot loop (pallas impl), on-device Monte-Carlo.  Validates the
+        # deliverable path end-to-end on hardware and captures the
+        # profiler traces the ring engineering is judged by.
+        import jax
+
+        nw = jax.device_count()
+        log(f"== stage mesh ({nw}-device mesh, platform="
+            f"{jax.devices()[0].platform}) ==")
+        mesh6 = dataclasses.replace(
+            base6, backend="mesh", n_workers=nw,
+            n_reps=8 if q else 50,
+        )
+        run(mesh6, "mesh_n1e6.jsonl", chunk=None if q else 4,
+            trace_dir=os.path.join(RESULTS, "trace_mesh_complete"))
+        run(dataclasses.replace(mesh6, scheme="repartitioned", n_rounds=4),
+            "mesh_n1e6.jsonl", chunk=None if q else 4,
+            trace_dir=os.path.join(RESULTS, "trace_mesh_repart"))
+        run(dataclasses.replace(mesh6, scheme="local"), "mesh_n1e6.jsonl",
+            chunk=None if q else 4)
+
+    if "figs" in stages:
+        log("== stage figures ==")
+        from tuplewise_tpu.harness.figures import (
+            plot_variance_vs_pairs, plot_variance_vs_rounds,
+            plot_variance_vs_wallclock, plot_variance_vs_workers,
+        )
+
+        def load(name):
+            p = os.path.join(RESULTS, name)
+            if not os.path.exists(p):
+                return []
+            with open(p) as f:
+                return [json.loads(x) for x in f if x.strip()]
+
+        figs = os.path.join(RESULTS, "figures")
+        for scale in ("n1e6", "n1e7"):
+            rounds = load(f"rounds_{scale}.jsonl")
+            var = load(f"variance_{scale}.jsonl")
+            pairs = load(f"pairs_{scale}.jsonl")
+            comp = next(
+                (r for r in var if r["config"]["scheme"] == "complete"),
+                None,
+            )
+            if rounds:
+                plot_variance_vs_rounds(
+                    rounds, os.path.join(figs, f"var_vs_rounds_{scale}.png"),
+                    baseline=comp,
+                )
+                plot_variance_vs_wallclock(
+                    rounds + ([comp] if comp else []),
+                    os.path.join(figs, f"var_vs_wallclock_{scale}.png"),
+                )
+            if pairs:
+                plot_variance_vs_pairs(
+                    pairs, os.path.join(figs, f"var_vs_pairs_{scale}.png"),
+                )
+        # trade-off-regime figures with the closed-form overlay
+        tthe = {}
+        tpath = os.path.join(RESULTS, "tradeoff_theory.json")
+        if os.path.exists(tpath):
+            with open(tpath) as f:
+                tthe = json.load(f)
+        tcomp = load("tradeoff_complete.jsonl")
+        tcomp = tcomp[0] if tcomp else None
+        workers = load("tradeoff_workers.jsonl")
+        if workers:
+            plot_variance_vs_workers(
+                workers, os.path.join(figs, "var_vs_workers.png"),
+                baseline=tcomp, theory=tthe.get("workers"),
+            )
+        for name in sorted(os.listdir(RESULTS)):
+            if name.startswith("tradeoff_rounds_N"):
+                N = name[len("tradeoff_rounds_N"):-len(".jsonl")]
+                plot_variance_vs_rounds(
+                    load(name),
+                    os.path.join(figs, f"var_vs_rounds_N{N}.png"),
+                    baseline=tcomp,
+                    theory=(tthe.get("rounds") or {}).get(N),
+                )
+        log("figures written to results/figures/")
+
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

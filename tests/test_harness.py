@@ -78,12 +78,40 @@ class TestVarianceExperiment:
         ) / 500
         assert abs(r["variance"] - pred) / pred < 0.35
 
+    def test_dense_many_workers_local_matches_closed_form(self):
+        """Small per-worker blocks take the dense [N, m1, m2] broadcast
+        path; its variance must match the Hoeffding closed form and sit
+        visibly ABOVE the complete-U floor (the paper's trade-off
+        regime) [SURVEY §1.2 item 2, §5.1]."""
+        cfg = VarianceConfig(
+            n_pos=96, n_neg=96, n_workers=24, n_reps=400, scheme="local"
+        )
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"]
+        assert abs(r["mean"] - true_gaussian_auc(1.0)) < 5 * r["std_error"]
+
+        from tuplewise_tpu.estimators.variance import (
+            two_sample_variance_from_zetas, two_sample_zetas,
+        )
+
+        X, Y = make_gaussians(20_000, 20_000, 1, 1.0, seed=5)
+        z = two_sample_zetas("auc", X[:, 0], Y[:, 0])
+        v_loc = two_sample_variance_from_zetas(z, 4, 4) / 24
+        v_comp = two_sample_variance_from_zetas(z, 96, 96)
+        # the deficit scales as (zeta_11/(zeta_10+zeta_01) - 1)/m,
+        # about +25% at m=4 (zeta_11 ~ 2x zeta' for Gaussian AUC)
+        assert v_loc > 1.08 * v_comp       # the gap exists in theory...
+        assert 0.6 * v_loc < r["variance"] < 1.6 * v_loc   # ...and in MC
+
     def test_pallas_branch_interpret_parity(self, monkeypatch):
         """TUPLEWISE_HARNESS_PALLAS=interpret exercises the TPU-only
         Pallas routing of the vmapped runner on CPU: same estimates as
         the XLA scan path to float32 tolerance."""
         monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "off")
-        cfg = VarianceConfig(n_pos=300, n_neg=260, n_workers=4, n_reps=4)
+        # n/N large enough that local blocks (m1*m2 = 90000 > 2^16)
+        # stay OFF the dense path — both schemes here must route
+        # through hot_pair_mean or the parity is vacuous
+        cfg = VarianceConfig(n_pos=600, n_neg=600, n_workers=2, n_reps=4)
         xla = run_variance_experiment(cfg)
         monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "interpret")
         pal = run_variance_experiment(cfg)

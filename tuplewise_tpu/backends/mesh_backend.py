@@ -231,8 +231,8 @@ class MeshBackend:
         def incomplete_body(key, a, ma, ia, b, mb, ib, n_pairs):
             """[1, cap] blocks; sample n_pairs//N local tuples per shard.
             Padded rows are avoided by sampling from the valid prefix
-            (pack_shards packs valid rows first; pack_all only pads the
-            tail shard — we sample indices < valid_count)."""
+            (both packers place valid rows first and pad only the tail
+            — we sample indices < valid_count)."""
             del ma, mb  # blocks come from pack_partition: no padding
             # linearized shard id across all mesh axes
             shard = lax.axis_index(axes[0])

@@ -19,30 +19,59 @@ def _results(path_or_list):
         return [json.loads(line) for line in f if line.strip()]
 
 
-def plot_variance_vs_rounds(results, out_png: str,
-                            baseline: Optional[dict] = None) -> str:
-    """Variance vs T (repartitions) — the communication trade-off curve
-    [SURVEY §1.2 item 3]; optionally overlays the complete-U variance."""
+def _plot_variance_loglog(results, out_png, x_key, xlabel, series_label,
+                          baseline=None, theory=None) -> str:
+    """Shared log-log variance plot: measured series, optional
+    closed-form Hoeffding overlay, optional complete-U floor."""
     import matplotlib
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
     rs = _results(results)
-    T = [r["config"]["n_rounds"] for r in rs]
+    x = [r["config"][x_key] for r in rs]
     var = [r["variance"] for r in rs]
     fig, ax = plt.subplots(figsize=(5, 3.5))
-    ax.loglog(T, var, "o-", label="repartitioned $U_{N,T}$")
+    ax.loglog(x, var, "o-", label=series_label)
+    if theory:
+        ax.loglog(*zip(*theory), ":", c="C1",
+                  label="Hoeffding closed form")
     if baseline is not None:
         ax.axhline(baseline["variance"], ls="--", c="gray",
                    label="complete $U_n$")
-    ax.set_xlabel("repartition rounds T (communication)")
+    ax.set_xlabel(xlabel)
     ax.set_ylabel("estimator variance")
     ax.legend()
     fig.tight_layout()
     fig.savefig(out_png, dpi=150)
     plt.close(fig)
     return out_png
+
+
+def plot_variance_vs_rounds(results, out_png: str,
+                            baseline: Optional[dict] = None,
+                            theory: Optional[list] = None) -> str:
+    """Variance vs T (repartitions) — the communication trade-off curve
+    [SURVEY §1.2 item 3]; optionally overlays the complete-U variance
+    and the closed-form Hoeffding prediction (list of (T, var))."""
+    return _plot_variance_loglog(
+        results, out_png, "n_rounds",
+        "repartition rounds T (communication)",
+        "repartitioned $U_{N,T}$", baseline, theory,
+    )
+
+
+def plot_variance_vs_workers(results, out_png: str,
+                             baseline: Optional[dict] = None,
+                             theory: Optional[list] = None) -> str:
+    """Variance of the local-average estimator vs worker count N — the
+    paper's 'what local averaging costs' figure [SURVEY §1.2 item 2].
+    The gap off the complete-U floor scales as ~1/m with m = n/N
+    per-worker rows, so it only opens up once blocks get small."""
+    return _plot_variance_loglog(
+        results, out_png, "n_workers", "workers N",
+        "local average $U^{loc}_N$", baseline, theory,
+    )
 
 
 def plot_variance_vs_wallclock(results, out_png: str) -> str:

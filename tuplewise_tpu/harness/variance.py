@@ -139,10 +139,22 @@ def _make_vmapped_runner(cfg: VarianceConfig):
 
     from tuplewise_tpu.parallel.device_partition import draw_blocks
 
+    # The paper's trade-off regime is MANY workers with small per-worker
+    # blocks (the local-vs-complete variance gap scales as
+    # zeta_11/(n*m), m = per-worker rows — invisible unless m is tens)
+    # [SURVEY §1.2]. Tiny blocks would drown a per-worker tiled kernel
+    # in launch overhead, so small worker grids take one dense
+    # broadcast over the [N, m1, m2] stack instead.
+    dense_local = (n1 // N) * (n2 // N) <= 1 << 16
+
     def local_round(s1, s2, key):
         k1, k2 = jax.random.split(key)
         b1 = s1[draw_blocks(k1, n1, N, cfg.partition_scheme)]
         b2 = s2[draw_blocks(k2, n2, N, cfg.partition_scheme)]
+        if dense_local:
+            # equal block sizes make the mean over the [N, m1, m2]
+            # grid equal the mean of per-worker means
+            return jnp.mean(kernel.diff(b1[:, :, None] - b2[:, None, :], jnp))
         return jnp.mean(jax.vmap(hot_pair_mean)(b1, b2))
 
     def one_rep(rep):
@@ -153,9 +165,15 @@ def _make_vmapped_runner(cfg: VarianceConfig):
         if cfg.scheme == "local":
             return local_round(s1, s2, fold(key, "partition"))
         if cfg.scheme == "repartitioned":
-            rounds = jax.vmap(
-                lambda t: local_round(s1, s2, fold(key, "partition", t))
-            )(jnp.arange(cfg.n_rounds))
+            # sequential over rounds (lax.map, not vmap): each round's
+            # gathered worker blocks are O(n) live memory, and a round
+            # already saturates the chip — vmapping T rounds would
+            # materialize T block sets at once (HBM blow-up at n=10^7,
+            # T=16) for no throughput gain
+            rounds = jax.lax.map(
+                lambda t: local_round(s1, s2, fold(key, "partition", t)),
+                jnp.arange(cfg.n_rounds),
+            )
             return jnp.mean(rounds)
         if cfg.scheme == "incomplete":
             return pair_tiles.incomplete_pair_mean(

@@ -90,6 +90,12 @@ def pallas_pair_sum(
             f"({tile_a}, {tile_b})"
         )
     g1, g2 = n1 // tile_a, n2 // tile_b
+    if g1 > 1536:
+        raise ValueError(
+            f"n1={n1} with tile_a={tile_a} needs {g1} SMEM accumulator "
+            f"cells (> the ~1536 budget); raise tile_a or use "
+            f"pallas_masked_pair_sum, which auto-grows its tile"
+        )
     col = s1.reshape(n1, 1)
     row = s2.reshape(1, n2)
     partials = pl.pallas_call(
@@ -168,6 +174,14 @@ def pallas_masked_pair_sum(
             f"{kernel.name!r} (kind={kernel.kind})"
         )
     from tuplewise_tpu.ops.pair_tiles import _pad_axis0
+
+    # The [g1, 2] per-row-block accumulator lives in SMEM (1 MiB, and
+    # each f32 cell pads to a 512-byte word there): cap the row-block
+    # count by growing tile_a for huge n1 — at n1=5e6 the default 2048
+    # tile would need g1=2442 > the ~2048-cell budget and Mosaic
+    # refuses the allocation. Padding waste stays <= one tile_a.
+    while -(-s1.shape[0] // tile_a) > 1536:
+        tile_a *= 2
 
     s1, m1 = _pad_axis0(s1, tile_a), _pad_axis0(m1, tile_a)
     s2, m2 = _pad_axis0(s2, tile_b), _pad_axis0(m2, tile_b)

@@ -14,8 +14,6 @@ from tuplewise_tpu.parallel.partition import (
     draw_pair_design,
     partition_indices,
     partition_two_sample,
-    pack_shards,
-    pack_two_sample_shards,
 )
 
 __all__ = [
@@ -26,8 +24,6 @@ __all__ = [
     "run_with_fault_tolerance",
     "partition_indices",
     "partition_two_sample",
-    "pack_shards",
-    "pack_two_sample_shards",
     "sample_failures",
     "survivors",
 ]

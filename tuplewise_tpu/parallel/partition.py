@@ -149,8 +149,7 @@ def draw_pair_design(
 def pack_all(values: np.ndarray, n_workers: int):
     """Deterministically pack EVERY row into [N, cap, ...] + mask + ids.
 
-    Unlike :func:`pack_shards` (random partition, remainder dropped),
-    this keeps all n rows — cap = ceil(n / N), tail zero-padded with a
+    Keeps all n rows — cap = ceil(n / N), tail zero-padded with a
     zero mask — which is what complete (all-pairs) statistics need.
     Returns (packed, mask, ids) with ids = original row index (padding
     gets id -1, excluded by masks anyway).
@@ -168,33 +167,3 @@ def pack_all(values: np.ndarray, n_workers: int):
         [np.arange(n), np.full(pad, -1)]
     ).astype(np.int32).reshape(n_workers, cap)
     return packed, mask, ids
-
-def pack_shards(
-    values: np.ndarray,
-    n_workers: int,
-    rng: np.random.Generator,
-    scheme: str = "swor",
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Shard ``values`` (leading axis) into [N, cap, ...] blocks + mask.
-
-    XLA needs static shapes [SURVEY §7 "Hard parts"], so every shard holds
-    exactly ``cap = n // N`` rows; the mask is all-ones here but downstream
-    tile code is written mask-aware so padded packings compose.
-    """
-    idx = partition_indices(len(values), n_workers, rng, scheme)
-    packed = values[idx]
-    mask = np.ones(idx.shape, dtype=values.dtype if np.issubdtype(values.dtype, np.floating) else np.float64)
-    return packed, mask
-
-
-def pack_two_sample_shards(
-    pos: np.ndarray,
-    neg: np.ndarray,
-    n_workers: int,
-    rng: np.random.Generator,
-    scheme: str = "swor",
-):
-    """Stratified two-sample packing: ([N,c1,...], mask1, [N,c2,...], mask2)."""
-    p, mp = pack_shards(pos, n_workers, rng, scheme)
-    q, mq = pack_shards(neg, n_workers, rng, scheme)
-    return p, mp, q, mq
