@@ -1,0 +1,215 @@
+"""BASELINE.json benchmark suite — one committed number per config.
+
+Self-baselined per SURVEY §6 (the reference published nothing): the
+frozen NumPy oracle path is the baseline, the JAX/TPU paths are the
+build. Emits results/configs.jsonl, one line per BASELINE config:
+
+  1 AUC U-statistic, synthetic Gaussians, n=10k: numpy vs jax vs pallas
+    parity + pairs/s
+  2 bipartite ranking, pairwise hinge, Adult: AUC lift + steps/s
+  3 incomplete U, n=10^6, B=10^4 (also in results/pairs_n1e6.jsonl)
+  4 degree-3 triplet kernel on MNIST embeddings: numpy/jax parity + time
+  5 cross-shard ring all-pairs at n=10^7 total: per-chip throughput of
+    the mesh backend (mesh of 1 on this host's chip; 8-shard semantics
+    are exercised on the virtual CPU mesh by tests/ and
+    __graft_entry__.dryrun_multichip)
+
+Usage: python scripts/config_suite.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+RESULTS = os.path.join(REPO, "results")
+
+
+def log(msg):
+    print(f"[configs] {msg}", file=sys.stderr, flush=True)
+
+
+def emit(rec, out):
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out.write(json.dumps(rec) + "\n")
+    out.flush()
+    log(json.dumps(rec))
+
+
+def timed(fn, reps=3):
+    fn()  # warm / compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def config1(out, q):
+    """AUC U-stat on Gaussians, n=10k total: parity + pairs/s."""
+    from tuplewise_tpu.data import make_gaussians
+    from tuplewise_tpu.estimators.estimator import Estimator
+
+    n = 640 if q else 5000
+    X, Y = make_gaussians(n, n, dim=1, separation=1.0, seed=0)
+    s1, s2 = X[:, 0], Y[:, 0]
+    vals, rates = {}, {}
+    for backend in ("numpy", "jax", "cpp"):
+        try:
+            est = Estimator("auc", backend=backend)
+        except Exception as e:
+            log(f"config1: {backend} unavailable: {e!r}")
+            continue
+        vals[backend] = float(est.complete(s1, s2))
+        rates[backend] = n * n / timed(lambda: est.complete(s1, s2))
+    emit({
+        "config": 1, "name": "auc_gaussians_n10k",
+        "n_pos": n, "n_neg": n, "estimates": vals,
+        "pairs_per_s": {k: round(v, 1) for k, v in rates.items()},
+        "max_parity_delta": max(
+            abs(v - vals["numpy"]) for v in vals.values()
+        ),
+    }, out)
+
+
+def config2(out, q):
+    """Pairwise hinge bipartite ranking on (surrogate) UCI Adult."""
+    from tuplewise_tpu.data import load_adult
+    from tuplewise_tpu.models.pairwise_sgd import (
+        TrainConfig, evaluate_auc, split_by_label, train_pairwise,
+    )
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    import jax
+
+    n = 400 if q else 8000
+    steps = 20 if q else 200
+    X, y, meta = load_adult(n=n, seed=0)
+    Xp, Xn = split_by_label(X, y)
+    scorer = LinearScorer(dim=Xp.shape[1])
+    p0 = scorer.init(0)
+    cfg = TrainConfig(kernel="hinge", lr=0.3, steps=steps,
+                      n_workers=min(4, jax.device_count()),
+                      repartition_every=10, seed=0)
+    t0 = time.perf_counter()
+    params, hist = train_pairwise(scorer, p0, Xp, Xn, cfg)
+    dt = time.perf_counter() - t0
+    emit({
+        "config": 2, "name": "pairwise_hinge_adult",
+        "n": n, "steps": steps, "n_workers": cfg.n_workers,
+        "data_synthetic": bool(meta["synthetic"]),
+        "auc_before": evaluate_auc(scorer, p0, Xp, Xn),
+        "auc_after": evaluate_auc(scorer, params, Xp, Xn),
+        "loss_first": float(hist["loss"][0]),
+        "loss_last": float(hist["loss"][-1]),
+        "steps_per_s": round(steps / dt, 2),
+    }, out)
+
+
+def config3(out, q):
+    """Incomplete U at n=10^6 total, B=10^4 (headline row also lives in
+    results/pairs_n1e6.jsonl with M=200 Monte-Carlo reps)."""
+    from tuplewise_tpu.data import make_gaussians
+    from tuplewise_tpu.estimators.estimator import Estimator
+
+    n = 1000 if q else 500_000
+    X, Y = make_gaussians(n, n, dim=1, separation=1.0, seed=0)
+    s1, s2 = X[:, 0], Y[:, 0]
+    est = Estimator("auc", backend="jax")
+    val = float(est.incomplete(s1, s2, n_pairs=10_000, seed=0))
+    dt = timed(lambda: est.incomplete(s1, s2, n_pairs=10_000, seed=0))
+    emit({
+        "config": 3, "name": "incomplete_n1e6_B1e4",
+        "n_pos": n, "n_neg": n, "B": 10_000, "estimate": val,
+        "seconds_per_estimate": round(dt, 5),
+        "mc_reference": "results/pairs_n1e6.jsonl",
+    }, out)
+
+
+def config4(out, q):
+    """Degree-3 triplet statistic on MNIST embeddings (surrogate unless
+    real IDX files are in TUPLEWISE_DATA_DIR)."""
+    from tuplewise_tpu.harness.triplet_experiment import (
+        triplet_mnist_statistic,
+    )
+
+    n = 200 if q else 2000
+    r_np = triplet_mnist_statistic(
+        kernel="triplet_indicator", backend="numpy", n=n,
+        n_pairs=20_000, seed=0,
+    )
+    t0 = time.perf_counter()
+    r_jx = triplet_mnist_statistic(
+        kernel="triplet_indicator", backend="jax", n=n,
+        n_pairs=20_000, seed=0,
+    )
+    dt = time.perf_counter() - t0
+    emit({
+        "config": 4, "name": "triplet_mnist",
+        "n": n, "numpy": r_np, "jax": r_jx,
+        "jax_seconds_total": round(dt, 3),
+    }, out)
+
+
+def config5(out, q):
+    """Cross-shard ring all-pairs at n=10^7 total: the mesh backend's
+    ppermute ring (mask-aware Pallas hot loop) on this host's chip."""
+    import jax
+
+    from tuplewise_tpu.backends.mesh_backend import MeshBackend
+    from tuplewise_tpu.ops.kernels import get_kernel
+
+    n = 1000 if q else 5_000_000   # per class; 2n = 10^7 total
+    rng = np.random.default_rng(5)
+    be = MeshBackend(get_kernel("auc"), n_workers=jax.device_count(),
+                     tile_a=2048, tile_b=8192)
+    pa = be._pack_complete(rng.standard_normal(n).astype(np.float32))
+    pb = be._pack_complete(rng.standard_normal(n).astype(np.float32))
+
+    def go():
+        (a, ma, ia), (b, mb, ib) = pa, pb
+        return float(be._complete(a, ma, ia, b, mb, ib))
+
+    val = go()
+    dt = timed(go, reps=1 if not q else 2)
+    emit({
+        "config": 5, "name": "ring_all_pairs_n1e7",
+        "n_pos": n, "n_neg": n, "n_shards": be.n_shards,
+        "impl": be.impl, "estimate": val,
+        "pairs_per_s_per_chip": round(n * n / dt / be.n_shards, 1),
+        "seconds": round(dt, 2),
+        "multi_shard_evidence":
+            "tests/test_mesh_backend.py + test_mesh_2d.py (8 virtual "
+            "CPU devices) + __graft_entry__.dryrun_multichip",
+    }, out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    args = ap.parse_args()
+    os.makedirs(RESULTS, exist_ok=True)
+    path = os.path.join(RESULTS, "configs.jsonl")
+    wanted = set(args.configs.split(","))
+    fns = {"1": config1, "2": config2, "3": config3, "4": config4,
+           "5": config5}
+    with open(path, "w") as out:
+        for key in sorted(wanted):
+            try:
+                fns[key](out, args.quick)
+            except Exception as e:  # keep the suite going; record why
+                emit({"config": int(key), "error": repr(e)}, out)
+    log(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

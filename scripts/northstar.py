@@ -74,6 +74,10 @@ def main():
                     help="comma list of stages to run")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
+    known = {"1e6", "1e7", "tradeoff", "mesh", "figs"}
+    if stages - known:
+        ap.error(f"unknown stages {sorted(stages - known)}; "
+                 f"choose from {sorted(known)}")
     os.makedirs(RESULTS, exist_ok=True)
     os.makedirs(os.path.join(RESULTS, "figures"), exist_ok=True)
 
@@ -130,8 +134,7 @@ def main():
         mt = 8 if q else 800
         baset = dataclasses.replace(base6, n_reps=mt)
         log(f"== stage tradeoff (n_pos=n_neg={n6}, M={mt}) ==")
-        comp = run(baset, "tradeoff_complete.jsonl",
-                   chunk=None if q else 8)
+        run(baset, "tradeoff_complete.jsonl", chunk=None if q else 8)
         n_sweep = (2, 4) if q else (8, 100, 1000, 12500, 125000, 250000)
         for N in n_sweep:
             run(dataclasses.replace(baset, scheme="local", n_workers=N),

@@ -1,0 +1,74 @@
+"""Summarize a jax.profiler trace directory into a small text table.
+
+The profiler writes perfetto JSON under
+``<dir>/plugins/profile/<run>/*.trace.json.gz``; this digests the
+device-side complete events ("ph" == "X") into per-op totals so the
+ring hot-loop profile can be committed as text (RESULTS.md) and diffed
+across rounds [VERDICT r1 next #10] — the raw trace is too big and too
+opaque to review.
+
+Usage: python scripts/trace_summary.py results/trace_mesh_complete [top_n]
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load_events(trace_dir: str):
+    pats = [
+        os.path.join(trace_dir, "**", "*.trace.json.gz"),
+        os.path.join(trace_dir, "**", "*.trace.json"),
+    ]
+    files = sorted(f for p in pats for f in glob.glob(p, recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no trace json under {trace_dir!r}")
+    events, pids = [], {}
+    for f in files:
+        op = gzip.open if f.endswith(".gz") else open
+        with op(f, "rt") as fh:
+            data = json.load(fh)
+        for e in data.get("traceEvents", []):
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pids[e.get("pid")] = e.get("args", {}).get("name", "")
+            elif e.get("ph") == "X":
+                events.append(e)
+    return events, pids
+
+
+def summarize(trace_dir: str, top_n: int = 15) -> str:
+    events, pids = load_events(trace_dir)
+    # keep device-side lanes (TPU/TensorCore/device XLA ops); python/
+    # host lanes carry dispatch noise, not the kernel profile
+    def is_device(e):
+        name = pids.get(e.get("pid"), "").lower()
+        return any(k in name for k in ("tpu", "device", "xla", "/tc"))
+
+    dev = [e for e in events if is_device(e)] or events
+    per_op = defaultdict(float)
+    t0 = min(e["ts"] for e in dev)
+    t1 = max(e["ts"] + e.get("dur", 0) for e in dev)
+    for e in dev:
+        per_op[e["name"]] += e.get("dur", 0.0)
+    total = sum(per_op.values())
+    lines = [
+        f"trace: {trace_dir}",
+        f"device events: {len(dev)}  span: {(t1 - t0) / 1e6:.3f}s  "
+        f"summed op time: {total / 1e6:.3f}s",
+        f"{'op':<58} {'total_ms':>10} {'share':>7}",
+    ]
+    for name, dur in sorted(per_op.items(), key=lambda kv: -kv[1])[:top_n]:
+        nm = name if len(name) <= 57 else name[:54] + "..."
+        lines.append(f"{nm:<58} {dur / 1e3:>10.2f} {dur / total:>6.1%}")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    d = sys.argv[1]
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 15
+    print(summarize(d, n))

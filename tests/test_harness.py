@@ -188,9 +188,21 @@ class TestCLIAndFigures:
         p2 = plot_variance_vs_wallclock(rs, str(tmp_path / "w.png"))
         ps = tradeoff_vs_pairs(cfg, pairs=(100, 1000))
         p3 = plot_variance_vs_pairs(ps, str(tmp_path / "b.png"))
+        from tuplewise_tpu.harness.figures import plot_variance_vs_workers
+
+        ws = [
+            run_variance_experiment(
+                dataclasses.replace(cfg, scheme="local", n_workers=N)
+            )
+            for N in (2, 8)
+        ]
+        p4 = plot_variance_vs_workers(
+            ws, str(tmp_path / "n.png"), baseline=base,
+            theory=[(2, 1e-4), (8, 2e-4)],
+        )
         import os
 
-        for p in (p1, p2, p3):
+        for p in (p1, p2, p3, p4):
             assert os.path.getsize(p) > 1000
 
 
@@ -202,6 +214,22 @@ class TestMeshMC:
 
         if jax.device_count() < 8:
             pytest.skip("needs 8 virtual devices")
+
+    @pytest.mark.parametrize("scheme", ["complete", "local"])
+    def test_pallas_branches_interpret_parity(self, scheme, monkeypatch):
+        """TUPLEWISE_HARNESS_PALLAS=interpret drives the mesh runner's
+        TPU-only Pallas branches (ring stats + local means) on the CPU
+        mesh; estimates must match the XLA scan path."""
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            n_pos=256, n_neg=256, n_workers=8, n_reps=4,
+            backend="mesh", scheme=scheme,
+        )
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "off")
+        xla = run_variance_experiment(cfg)
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "interpret")
+        pal = run_variance_experiment(cfg)
+        assert abs(pal["mean"] - xla["mean"]) < 1e-6
 
     @pytest.mark.parametrize(
         "scheme", ["complete", "local", "repartitioned", "incomplete"]

@@ -66,6 +66,21 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
     PA = P(axis)
     shard2 = NamedSharding(mesh, PA)
     tile_a, tile_b = min(tile, m1), min(tile, m2)
+    # same impl selection as MeshBackend — the ring hot loop runs the
+    # mask-aware Pallas kernel on TPU, the XLA scan elsewhere — with the
+    # same TUPLEWISE_HARNESS_PALLAS=interpret|off override the jax
+    # backend honors, so CI can exercise (and TPU can bypass) the
+    # Pallas branches here too
+    import os
+
+    mode = os.environ.get("TUPLEWISE_HARNESS_PALLAS", "auto")
+    interpret = mode == "interpret"
+    use_pallas = interpret or (
+        mode != "off" and mesh.devices.flat[0].platform == "tpu"
+    )
+    impl = "pallas" if use_pallas else "xla"
+    if use_pallas and not interpret:
+        tile_a, tile_b = max(tile_a, 2048), max(tile_b, 8192)
 
     # ---- per-shard data generation (no packing, no transfer) --------- #
     def gen_body(key):
@@ -84,7 +99,7 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
     def complete_body(a, b):
         s, c = ring.ring_pair_stats(
             kernel, a[0], b[0], axis_name=axis,
-            tile_a=tile_a, tile_b=tile_b,
+            tile_a=tile_a, tile_b=tile_b, impl=impl,
         )
         return s / c
 
@@ -94,6 +109,19 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
     )
 
     def local_mean_body(a, b):
+        if use_pallas:
+            from tuplewise_tpu.ops.pallas_pairs import (
+                pallas_masked_pair_sum,
+            )
+
+            s = pallas_masked_pair_sum(
+                a[0], b[0], jnp.ones_like(a[0]), jnp.ones_like(b[0]),
+                kernel=kernel, tile_a=tile_a, tile_b=tile_b,
+                interpret=interpret,
+            )
+            # blocks are full (N*m == n), so the count is exactly m1*m2;
+            # python float — the product can exceed int32 inside jit
+            return (s / float(m1 * m2))[None]
         s, c = pair_tiles.pair_stats(
             kernel, a[0], b[0], tile_a=tile_a, tile_b=tile_b
         )

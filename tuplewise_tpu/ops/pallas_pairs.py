@@ -178,7 +178,7 @@ def pallas_masked_pair_sum(
     # The [g1, 2] per-row-block accumulator lives in SMEM (1 MiB, and
     # each f32 cell pads to a 512-byte word there): cap the row-block
     # count by growing tile_a for huge n1 — at n1=5e6 the default 2048
-    # tile would need g1=2442 > the ~2048-cell budget and Mosaic
+    # tile would need g1=2442 > the 1536-cell budget and Mosaic
     # refuses the allocation. Padding waste stays <= one tile_a.
     while -(-s1.shape[0] // tile_a) > 1536:
         tile_a *= 2
