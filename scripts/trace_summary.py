@@ -43,6 +43,11 @@ def load_events(trace_dir: str):
 
 def summarize(trace_dir: str, top_n: int = 15) -> str:
     events, pids = load_events(trace_dir)
+    if not events:
+        raise ValueError(
+            f"{trace_dir!r} has trace JSON but no complete ('X') "
+            f"events — aborted or host-only profiler run?"
+        )
     # keep device-side lanes (TPU/TensorCore/device XLA ops); python/
     # host lanes carry dispatch noise, not the kernel profile
     def is_device(e):
@@ -64,7 +69,8 @@ def summarize(trace_dir: str, top_n: int = 15) -> str:
     ]
     for name, dur in sorted(per_op.items(), key=lambda kv: -kv[1])[:top_n]:
         nm = name if len(name) <= 57 else name[:54] + "..."
-        lines.append(f"{nm:<58} {dur / 1e3:>10.2f} {dur / total:>6.1%}")
+        share = dur / total if total else 0.0
+        lines.append(f"{nm:<58} {dur / 1e3:>10.2f} {share:>6.1%}")
     return "\n".join(lines)
 
 

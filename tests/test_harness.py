@@ -126,6 +126,29 @@ class TestVarianceExperiment:
         )
         assert abs(loc["mean"] - loc_xla["mean"]) < 1e-6
 
+    def test_pallas_smem_guard_and_tile_picker(self):
+        """The unmasked kernel refuses row-block counts past the SMEM
+        budget (clear error, no Mosaic crash); the tile picker narrows
+        lanes for transcendental kernels."""
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops.kernels import (
+            auc_kernel, logistic_kernel,
+        )
+        from tuplewise_tpu.ops.pallas_pairs import (
+            pallas_pair_sum, preferred_pair_tiles,
+        )
+
+        big = jnp.zeros(256 * 1537, jnp.float32)
+        with pytest.raises(ValueError, match="SMEM"):
+            pallas_pair_sum(
+                big, big[:4096], kernel=auc_kernel,
+                tile_a=256, tile_b=4096, interpret=True,
+            )
+        assert preferred_pair_tiles(auc_kernel, 10**6, 10**6) == (2048, 8192)
+        assert preferred_pair_tiles(logistic_kernel, 10**6, 10**6) == (2048, 2048)
+        assert preferred_pair_tiles(auc_kernel, 300, 300) == (256, 2048)
+
     def test_numpy_backend_loop_path(self):
         cfg = VarianceConfig(
             backend="numpy", n_pos=128, n_neg=128, n_reps=20,

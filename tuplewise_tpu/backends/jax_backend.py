@@ -28,6 +28,7 @@ from jax import lax
 from tuplewise_tpu.backends.base import register_backend
 from tuplewise_tpu.ops import pair_tiles
 from tuplewise_tpu.ops.kernels import Kernel, get_kernel
+from tuplewise_tpu.ops.pallas_pairs import MAX_ROW_BLOCKS
 from tuplewise_tpu.utils.rng import fold, root_key
 
 
@@ -81,8 +82,13 @@ class JaxBackend:
                 if (impl == "pallas" and k.kind == "diff"
                         and platform in ("tpu", "cpu")  # gpu: XLA path
                         and A.shape[0] % tile_a == 0
-                        and B.shape[0] % tile_b == 0):
-                    from tuplewise_tpu.ops.pallas_pairs import pallas_pair_sum
+                        and B.shape[0] % tile_b == 0
+                        # SMEM accumulator budget; beyond it, the
+                        # XLA scan fallback below takes over
+                        and A.shape[0] // tile_a <= MAX_ROW_BLOCKS):
+                    from tuplewise_tpu.ops.pallas_pairs import (
+                        pallas_pair_sum,
+                    )
 
                     s = pallas_pair_sum(
                         A, B, kernel=k,

@@ -70,17 +70,19 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
     # mask-aware Pallas kernel on TPU, the XLA scan elsewhere — with the
     # same TUPLEWISE_HARNESS_PALLAS=interpret|off override the jax
     # backend honors, so CI can exercise (and TPU can bypass) the
-    # Pallas branches here too
-    import os
+    # Pallas branches here too. The MESH's platform decides (it can
+    # differ from the default backend's).
+    from tuplewise_tpu.ops.pallas_pairs import resolve_pallas_mode
 
-    mode = os.environ.get("TUPLEWISE_HARNESS_PALLAS", "auto")
-    interpret = mode == "interpret"
-    use_pallas = interpret or (
-        mode != "off" and mesh.devices.flat[0].platform == "tpu"
+    use_pallas, interpret = resolve_pallas_mode(
+        mesh.devices.flat[0].platform
     )
     impl = "pallas" if use_pallas else "xla"
     if use_pallas and not interpret:
-        tile_a, tile_b = max(tile_a, 2048), max(tile_b, 8192)
+        from tuplewise_tpu.ops.pallas_pairs import preferred_pair_tiles
+
+        pa_, pb_ = preferred_pair_tiles(kernel, m1, m2)
+        tile_a, tile_b = max(tile_a, pa_), max(tile_b, pb_)
 
     # ---- per-shard data generation (no packing, no transfer) --------- #
     def gen_body(key):
@@ -100,6 +102,7 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
         s, c = ring.ring_pair_stats(
             kernel, a[0], b[0], axis_name=axis,
             tile_a=tile_a, tile_b=tile_b, impl=impl,
+            interpret=interpret or None,
         )
         return s / c
 

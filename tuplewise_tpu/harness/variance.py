@@ -106,24 +106,23 @@ def _make_vmapped_runner(cfg: VarianceConfig):
     # interpret-mode Pallas is far slower than compiled XLA there.
     # TUPLEWISE_HARNESS_PALLAS=interpret|off overrides the platform
     # gate so CI can exercise this branch without a TPU.
-    import os
+    from tuplewise_tpu.ops.pallas_pairs import resolve_pallas_mode
 
-    mode = os.environ.get("TUPLEWISE_HARNESS_PALLAS", "auto")
-    interpret = mode == "interpret"
-    use_pallas = interpret or (
-        mode != "off" and jax.devices()[0].platform == "tpu"
+    use_pallas, interpret = resolve_pallas_mode(
+        jax.devices()[0].platform
     )
 
     def hot_pair_mean(a, b):
         m1, m2 = a.shape[0], b.shape[0]
         if use_pallas:
-            from tuplewise_tpu.ops.pallas_pairs import pallas_masked_pair_sum
+            from tuplewise_tpu.ops.pallas_pairs import (
+                pallas_masked_pair_sum, preferred_pair_tiles,
+            )
 
+            ta, tb = preferred_pair_tiles(kernel, m1, m2)
             s = pallas_masked_pair_sum(
                 a, b, jnp.ones_like(a), jnp.ones_like(b), kernel=kernel,
-                tile_a=2048 if m1 >= 2048 else 256,
-                tile_b=8192 if m2 >= 8192 else 2048,
-                interpret=interpret,
+                tile_a=ta, tile_b=tb, interpret=interpret,
             )
             # python float, not int: m1*m2 can exceed int32 inside jit
             return s / float(m1 * m2)

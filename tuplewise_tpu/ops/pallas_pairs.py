@@ -39,6 +39,35 @@ from jax.experimental.pallas import tpu as pltpu
 from tuplewise_tpu.ops.kernels import Kernel
 
 
+MAX_ROW_BLOCKS = 1536  # [g1, 2] SMEM accumulator budget (~1 MiB / 512 B)
+
+
+def resolve_pallas_mode(platform: str):
+    """(use_pallas, interpret) for a harness hot loop executing on
+    ``platform``, honoring TUPLEWISE_HARNESS_PALLAS=interpret|off —
+    the single copy of the override semantics shared by
+    harness.variance and harness.mesh_mc."""
+    import os
+
+    mode = os.environ.get("TUPLEWISE_HARNESS_PALLAS", "auto")
+    interpret = mode == "interpret"
+    return interpret or (mode != "off" and platform == "tpu"), interpret
+
+
+def preferred_pair_tiles(kernel: Kernel, m1: int, m2: int):
+    """Measured-best (tile_a, tile_b) for the masked kernel on v5e.
+
+    Cheap elementwise bodies (auc/hinge) run traversal-bound at wide
+    lane tiles (2048x8192 ~= 7e11 pairs/s); transcendental bodies
+    (logistic) lose ~40% at 8192 lanes to register pressure — 2048 is
+    their sweet spot. Small inputs shrink to keep padding waste low.
+    """
+    ta = 2048 if m1 >= 2048 else 256
+    if kernel.transcendental:
+        return ta, 2048
+    return ta, 8192 if m2 >= 8192 else 2048
+
+
 def _pair_sum_kernel(a_ref, b_ref, o_ref, *, g):
     i, j = pl.program_id(0), pl.program_id(1)
 
@@ -90,11 +119,11 @@ def pallas_pair_sum(
             f"({tile_a}, {tile_b})"
         )
     g1, g2 = n1 // tile_a, n2 // tile_b
-    if g1 > 1536:
+    if g1 > MAX_ROW_BLOCKS:
         raise ValueError(
             f"n1={n1} with tile_a={tile_a} needs {g1} SMEM accumulator "
-            f"cells (> the ~1536 budget); raise tile_a or use "
-            f"pallas_masked_pair_sum, which auto-grows its tile"
+            f"cells (> the {MAX_ROW_BLOCKS} budget); raise tile_a or "
+            f"use pallas_masked_pair_sum, which auto-grows its tile"
         )
     col = s1.reshape(n1, 1)
     row = s2.reshape(1, n2)
@@ -180,7 +209,7 @@ def pallas_masked_pair_sum(
     # count by growing tile_a for huge n1 — at n1=5e6 the default 2048
     # tile would need g1=2442 > the 1536-cell budget and Mosaic
     # refuses the allocation. Padding waste stays <= one tile_a.
-    while -(-s1.shape[0] // tile_a) > 1536:
+    while -(-s1.shape[0] // tile_a) > MAX_ROW_BLOCKS:
         tile_a *= 2
 
     s1, m1 = _pad_axis0(s1, tile_a), _pad_axis0(m1, tile_a)
