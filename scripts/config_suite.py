@@ -102,15 +102,96 @@ def config2(out, q):
     t0 = time.perf_counter()
     params, hist = train_pairwise(scorer, p0, Xp, Xn, cfg)
     dt = time.perf_counter() - t0
+    auc0 = evaluate_auc(scorer, p0, Xp, Xn)
+    auc1 = evaluate_auc(scorer, params, Xp, Xn)
+    fig = None
+    try:  # figure is a bonus — never lose the metrics record to it
+        from tuplewise_tpu.harness.figures import plot_learning_curve
+
+        figdir = os.path.join(RESULTS, "figures")
+        os.makedirs(figdir, exist_ok=True)
+        fig = plot_learning_curve(
+            hist, os.path.join(figdir, "learning_curve_adult.png"),
+            auc_before=auc0, auc_after=auc1,
+        )
+    except Exception as e:
+        log(f"config2: learning-curve figure failed: {e!r}")
     emit({
         "config": 2, "name": "pairwise_hinge_adult",
         "n": n, "steps": steps, "n_workers": cfg.n_workers,
         "data_synthetic": bool(meta["synthetic"]),
-        "auc_before": evaluate_auc(scorer, p0, Xp, Xn),
-        "auc_after": evaluate_auc(scorer, params, Xp, Xn),
+        "auc_before": auc0, "auc_after": auc1,
         "loss_first": float(hist["loss"][0]),
         "loss_last": float(hist["loss"][-1]),
         "steps_per_s": round(steps / dt, 2),
+        "figure": fig,
+    }, out)
+
+
+def config2b(out, q):
+    """Gradient throughput of the pairwise learner's hot loop.
+
+    Measured via a self-contained jitted SGD scan rather than
+    train_pairwise: the trainer rebuilds jitted closures per call, so
+    call-level timing is confounded by (jittery, tens-of-seconds)
+    remote compiles. Both gradient paths are reported: analytic
+    streamed g' (the trainer's path for hinge/logistic) vs autodiff
+    through the checkpointed tiles (the fallback for kernels without
+    diff_grad_fn)."""
+    from tuplewise_tpu.data import make_gaussians
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tuplewise_tpu.ops import pair_tiles
+    from tuplewise_tpu.ops.kernels import get_kernel
+
+    n = 512 if q else 100_000   # per class
+    steps = 3 if q else 10
+    kernel = get_kernel("hinge")
+    Xp, Xn = make_gaussians(n, n, dim=5, separation=1.0, seed=1)
+    Xp, Xn = jnp.asarray(Xp, jnp.float32), jnp.asarray(Xn, jnp.float32)
+    scorer = LinearScorer(dim=5)
+    p0 = jax.tree.map(jnp.asarray, scorer.init(1))
+    rng = np.random.default_rng(2)
+
+    def sync(tree):
+        return float(sum(np.sum(np.asarray(x))
+                         for x in jax.tree.leaves(tree)))
+
+    rates = {}
+    for label, mean_fn in (
+        ("analytic_gp", lambda s1, s2: pair_tiles.diff_pair_mean(
+            kernel, s1, s2, 2048, 2048)),
+        ("autodiff_tiles", lambda s1, s2: pair_tiles.pair_mean(
+            kernel, s1, s2, tile_a=2048, tile_b=2048)),
+    ):
+        def loss(p):
+            return mean_fn(
+                scorer.apply(p, Xp, jnp), scorer.apply(p, Xn, jnp)
+            )
+
+        def step(p, _):
+            l, g = jax.value_and_grad(loss)(p)
+            return jax.tree.map(lambda x, gg: x - 0.1 * gg, p, g), l
+
+        f = jax.jit(lambda p: lax.scan(step, p, None, length=steps))
+        sync(f(p0))  # compile (cached: same jit object reused)
+        ts = []
+        for _ in range(3):
+            pp = jax.tree.map(
+                lambda x: x + 1e-6 * jnp.asarray(
+                    rng.standard_normal(x.shape), jnp.float32), p0)
+            t0 = time.perf_counter()
+            sync(f(pp))
+            ts.append(time.perf_counter() - t0)
+        rates[label] = round(steps * n * n / min(ts), 1)
+    emit({
+        "config": "2b", "name": "pairwise_grad_throughput",
+        "n_pos": n, "n_neg": n, "steps": steps, "tile": 2048,
+        "grad_pairs_per_s": rates,
     }, out)
 
 
@@ -195,19 +276,19 @@ def config5(out, q):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--configs", default="1,2,2b,3,4,5")
     args = ap.parse_args()
     os.makedirs(RESULTS, exist_ok=True)
     path = os.path.join(RESULTS, "configs.jsonl")
     wanted = set(args.configs.split(","))
-    fns = {"1": config1, "2": config2, "3": config3, "4": config4,
-           "5": config5}
+    fns = {"1": config1, "2": config2, "2b": config2b, "3": config3,
+           "4": config4, "5": config5}
     with open(path, "w") as out:
         for key in sorted(wanted):
             try:
                 fns[key](out, args.quick)
             except Exception as e:  # keep the suite going; record why
-                emit({"config": int(key), "error": repr(e)}, out)
+                emit({"config": key, "error": repr(e)}, out)
     log(f"wrote {path}")
 
 

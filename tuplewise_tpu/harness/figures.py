@@ -118,3 +118,28 @@ def plot_variance_vs_pairs(results, out_png: str) -> str:
     fig.savefig(out_png, dpi=150)
     plt.close(fig)
     return out_png
+
+
+def plot_learning_curve(history, out_png: str,
+                        auc_before: Optional[float] = None,
+                        auc_after: Optional[float] = None) -> str:
+    """Pairwise-SGD training curve [SURVEY §2 L5]: per-step surrogate
+    loss, with before/after test AUC annotated when provided."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    loss = np.asarray(history["loss"])
+    fig, ax = plt.subplots(figsize=(5, 3.5))
+    ax.plot(np.arange(len(loss)), loss, lw=1.2)
+    ax.set_xlabel("SGD step")
+    ax.set_ylabel("pairwise surrogate loss")
+    if auc_before is not None and auc_after is not None:
+        ax.set_title(
+            f"test AUC {auc_before:.3f} -> {auc_after:.3f}", fontsize=9
+        )
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png

@@ -102,7 +102,7 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
         s, c = ring.ring_pair_stats(
             kernel, a[0], b[0], axis_name=axis,
             tile_a=tile_a, tile_b=tile_b, impl=impl,
-            interpret=interpret or None,
+            interpret=interpret,
         )
         return s / c
 

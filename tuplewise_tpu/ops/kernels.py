@@ -51,6 +51,12 @@ class Kernel:
         pair kernels), or "triplet" (degree-3 feature kernels).
       diff_fn: for kind="diff": ``g(d, xp)`` applied elementwise to a
         score-difference array ``d = s_i - s_j``.
+      diff_grad_fn: optional analytic ``g'(d, xp)`` for diff kernels.
+        When present, the learner's all-pairs gradient streams row/col
+        reductions of g' in a single forward-style pass
+        (ops.pair_tiles.diff_pair_mean) instead of autodiffing through
+        the checkpointed tile scan — ~2 traversals of the grid total
+        rather than recompute-plus-transpose per tile.
       pair_fn: for kind="pair": ``h(a, b, xp)`` mapping feature blocks
         ``a [m, d]``, ``b [k, d]`` to an ``[m, k]`` kernel matrix.
       triplet_fn: for kind="triplet": ``h(a, p, n, xp)`` mapping anchor /
@@ -71,6 +77,7 @@ class Kernel:
     two_sample: bool
     kind: str
     diff_fn: Optional[Callable[..., Array]] = None
+    diff_grad_fn: Optional[Callable[..., Array]] = None
     pair_fn: Optional[Callable[..., Array]] = None
     triplet_fn: Optional[Callable[..., Array]] = None
     pair_elem_fn: Optional[Callable[..., Array]] = None
@@ -116,9 +123,19 @@ def _hinge_g(d, xp):
     return xp.maximum(0.0, 1.0 - d)
 
 
+def _hinge_gp(d, xp):
+    # dl/dd = -1{d < 1} (subgradient 0 at the kink)
+    return xp.where(d < 1.0, -1.0, 0.0)
+
+
 def _logistic_g(d, xp):
     # Pairwise logistic surrogate l(d) = log(1 + e^{-d}) [SURVEY §1.3]
     return _softplus(xp, -d)
+
+
+def _logistic_gp(d, xp):
+    # dl/dd = -sigmoid(-d) = -1 / (1 + e^{d})
+    return -1.0 / (1.0 + xp.exp(d))
 
 
 auc_kernel = Kernel(
@@ -128,12 +145,13 @@ auc_kernel = Kernel(
 
 hinge_kernel = Kernel(
     name="hinge", degree=2, two_sample=True, kind="diff",
-    diff_fn=_hinge_g, higher_is_better=False,
+    diff_fn=_hinge_g, diff_grad_fn=_hinge_gp, higher_is_better=False,
 )
 
 logistic_kernel = Kernel(
     name="logistic", degree=2, two_sample=True, kind="diff",
-    diff_fn=_logistic_g, higher_is_better=False, transcendental=True,
+    diff_fn=_logistic_g, diff_grad_fn=_logistic_gp,
+    higher_is_better=False, transcendental=True,
 )
 
 
