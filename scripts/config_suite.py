@@ -131,13 +131,13 @@ def config2(out, q):
 def config2b(out, q):
     """Gradient throughput of the pairwise learner's hot loop.
 
-    Measured via a self-contained jitted SGD scan rather than
-    train_pairwise: the trainer rebuilds jitted closures per call, so
-    call-level timing is confounded by (jittery, tens-of-seconds)
-    remote compiles. Both gradient paths are reported: analytic
-    streamed g' (the trainer's path for hinge/logistic) vs autodiff
-    through the checkpointed tiles (the fallback for kernels without
-    diff_grad_fn)."""
+    Measured via a self-contained jitted SGD scan: it isolates the
+    gradient hot loop from trainer plumbing and from remote-compile
+    jitter (train_pairwise itself now caches its compiled chunk per
+    configuration and matches this rate on repeat calls). Both
+    gradient paths are reported: analytic streamed g' (the trainer's
+    path for hinge/logistic) vs autodiff through the checkpointed
+    tiles (the fallback for kernels without diff_grad_fn)."""
     from tuplewise_tpu.data import make_gaussians
     from tuplewise_tpu.models.scorers import LinearScorer
 

@@ -62,6 +62,113 @@ class TrainConfig:
 # mesh trainer                                                          #
 # --------------------------------------------------------------------- #
 
+@functools.lru_cache(maxsize=8)
+def _compiled_trainer(scorer, cfg, mesh, n1, n2):
+    """Compiled chunk program for (scorer, cfg-sans-steps, mesh, sizes).
+
+    train_pairwise used to rebuild these closures (and thus recompile)
+    on every call; caching here makes repeated training runs — sweeps,
+    resumed sessions, the benchmark suite — pay one compile per
+    configuration. Data enters as arguments, so the cache holds no
+    array references; jit itself retraces per feature-dim/shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tuplewise_tpu.parallel.device_partition import draw_blocks as _draw
+
+    kernel = get_kernel(cfg.kernel)
+    N = int(np.prod(mesh.devices.shape))
+    axes = tuple(mesh.axis_names)
+    shard_blocks = NamedSharding(mesh, P(axes))
+    m1, m2 = n1 // N, n2 // N
+    root = root_key(cfg.seed)
+
+    def draw_blocks(key, n, m):
+        return _draw(key, n, N, cfg.scheme, m=m)
+
+    def sgd_body(params, a, b, key):
+        """One worker's step: local pair gradient, pmean, update.
+        a, b: [1, m, d] local blocks."""
+
+        def loss_fn(p):
+            s1 = scorer.apply(p, a[0], jnp)
+            s2 = scorer.apply(p, b[0], jnp)
+            if cfg.pairs_per_worker is None:
+                # analytic streamed g' backward when the surrogate
+                # declares one (hinge/logistic do): ~100x the
+                # autodiff-through-tiles gradient at n=10^5
+                return pair_tiles.pair_mean_for_grad(
+                    kernel, s1, s2, tile_a=cfg.tile, tile_b=cfg.tile
+                )
+            shard = lax.axis_index(axes[0])
+            for ax in axes[1:]:
+                shard = shard * lax.axis_size(ax) + lax.axis_index(ax)
+            kk = fold(key, "pair_sample", shard)
+            i, j = pair_tiles.sample_pair_indices(
+                kk, m1, m2, cfg.pairs_per_worker, one_sample=False
+            )
+            return jnp.mean(kernel.diff(s1[i] - s2[j], jnp))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)
+        loss = lax.pmean(loss, axes)
+        new_params = jax.tree.map(
+            lambda p, g: p - cfg.lr * g, params, grads
+        )
+        return new_params, loss
+
+    sgd_smap = jax.shard_map(
+        sgd_body,
+        mesh=mesh,
+        in_specs=(P(), P(axes), P(axes), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    def step_fn(carry, t, t0, Xp, Xn):
+        params, Ab, Bb = carry
+        kt = fold(root, "step", t)
+
+        def refresh(_):
+            kr = fold(root, "repartition", t)
+            k1, k2 = jax.random.split(kr)
+            i1 = draw_blocks(k1, n1, m1)
+            i2 = draw_blocks(k2, n2, m2)
+            return (
+                Xp.at[i1].get(out_sharding=shard_blocks),
+                Xn.at[i2].get(out_sharding=shard_blocks),
+            )
+
+        # the chunk's first blocks (incl. a boundary-aligned t0) are
+        # drawn by chunk_fn with the same key, so only refresh on LATER
+        # boundaries — one startup regather per chunk, not two
+        Ab, Bb = lax.cond(
+            (t % cfg.repartition_every == 0) & (t > t0),
+            refresh, lambda _: (Ab, Bb), None,
+        )
+        params, loss = sgd_smap(params, Ab, Bb, kt)
+        return (params, Ab, Bb), loss
+
+    def chunk_fn(params, Xp, Xn, t0, chunk_len):
+        """Steps [t0, t0 + chunk_len). Blocks are regathered as of the
+        most recent repartition boundary r0 = t0 - t0 % n_r with the key
+        folded from r0, so any chunking reproduces the unchunked run."""
+        r0 = t0 - t0 % cfg.repartition_every
+        kr = fold(root, "repartition", r0)
+        k1, k2 = jax.random.split(kr)
+        Ab = Xp.at[draw_blocks(k1, n1, m1)].get(out_sharding=shard_blocks)
+        Bb = Xn.at[draw_blocks(k2, n2, m2)].get(out_sharding=shard_blocks)
+        (params, _, _), losses = lax.scan(
+            functools.partial(step_fn, t0=t0, Xp=Xp, Xn=Xn),
+            (params, Ab, Bb), t0 + jnp.arange(chunk_len)
+        )
+        return params, losses
+
+    return jax.jit(chunk_fn, static_argnums=4)
+
+
 def train_pairwise(
     scorer,
     params,
@@ -120,90 +227,11 @@ def train_pairwise(
         replicated,
     )
 
-    def draw_blocks(key, n, m):
-        return _draw(key, n, N, cfg.scheme, m=m)
-
-    def sgd_body(params, a, b, key):
-        """One worker's step: local pair gradient, pmean, update.
-        a, b: [1, m, d] local blocks."""
-
-        def loss_fn(p):
-            s1 = scorer.apply(p, a[0], jnp)
-            s2 = scorer.apply(p, b[0], jnp)
-            if cfg.pairs_per_worker is None:
-                # analytic streamed g' backward when the surrogate
-                # declares one (hinge/logistic do): ~100x the
-                # autodiff-through-tiles gradient at n=10^5
-                return pair_tiles.pair_mean_for_grad(
-                    kernel, s1, s2, tile_a=cfg.tile, tile_b=cfg.tile
-                )
-            shard = lax.axis_index(axes[0])
-            for ax in axes[1:]:
-                shard = shard * lax.axis_size(ax) + lax.axis_index(ax)
-            kk = fold(key, "pair_sample", shard)
-            i, j = pair_tiles.sample_pair_indices(
-                kk, m1, m2, cfg.pairs_per_worker, one_sample=False
-            )
-            return jnp.mean(kernel.diff(s1[i] - s2[j], jnp))
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)
-        loss = lax.pmean(loss, axes)
-        new_params = jax.tree.map(
-            lambda p, g: p - cfg.lr * g, params, grads
-        )
-        return new_params, loss
-
-    sgd_smap = jax.shard_map(
-        sgd_body,
-        mesh=mesh,
-        in_specs=(P(), P(axes), P(axes), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
+    # compiled-chunk cache: key excludes steps (chunk length is an
+    # argument) so sweeps over step counts reuse the same executable
+    run_chunk = _compiled_trainer(
+        scorer, dataclasses.replace(cfg, steps=0), mesh, n1, n2
     )
-
-    root = root_key(cfg.seed)
-
-    def step_fn(carry, t, t0):
-        params, Ab, Bb = carry
-        kt = fold(root, "step", t)
-
-        def refresh(_):
-            kr = fold(root, "repartition", t)
-            k1, k2 = jax.random.split(kr)
-            i1 = draw_blocks(k1, n1, m1)
-            i2 = draw_blocks(k2, n2, m2)
-            return (
-                Xp.at[i1].get(out_sharding=shard_blocks),
-                Xn.at[i2].get(out_sharding=shard_blocks),
-            )
-
-        # the chunk's first blocks (incl. a boundary-aligned t0) are
-        # drawn by chunk_fn with the same key, so only refresh on LATER
-        # boundaries — one startup regather per chunk, not two
-        Ab, Bb = lax.cond(
-            (t % cfg.repartition_every == 0) & (t > t0),
-            refresh, lambda _: (Ab, Bb), None,
-        )
-        params, loss = sgd_smap(params, Ab, Bb, kt)
-        return (params, Ab, Bb), loss
-
-    def chunk_fn(params, t0, chunk_len):
-        """Steps [t0, t0 + chunk_len). Blocks are regathered as of the
-        most recent repartition boundary r0 = t0 - t0 % n_r with the key
-        folded from r0, so any chunking reproduces the unchunked run."""
-        r0 = t0 - t0 % cfg.repartition_every
-        kr = fold(root, "repartition", r0)
-        k1, k2 = jax.random.split(kr)
-        Ab = Xp.at[draw_blocks(k1, n1, m1)].get(out_sharding=shard_blocks)
-        Bb = Xn.at[draw_blocks(k2, n2, m2)].get(out_sharding=shard_blocks)
-        (params, _, _), losses = lax.scan(
-            functools.partial(step_fn, t0=t0),
-            (params, Ab, Bb), t0 + jnp.arange(chunk_len)
-        )
-        return params, losses
-
-    run_chunk = jax.jit(chunk_fn, static_argnums=2)
 
     # ---- checkpoint/resume plumbing [SURVEY §5.5] -------------------- #
     from tuplewise_tpu.utils.checkpoint import (
@@ -229,7 +257,9 @@ def train_pairwise(
             )
 
     for t, chunk in iter_chunks(start, cfg.steps, checkpoint_every):
-        params, losses = run_chunk(params, jnp.asarray(t, jnp.int32), chunk)
+        params, losses = run_chunk(
+            params, Xp, Xn, jnp.asarray(t, jnp.int32), chunk
+        )
         loss_parts.append(np.asarray(losses))
         if checkpoint_path:
             save_checkpoint(
