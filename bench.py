@@ -29,6 +29,9 @@ def _tpu_pairs_per_sec(n=1 << 20, tile_a=2048, tile_b=8192, reps=3):
     rng = np.random.default_rng(0)
     # DISTINCT inputs per rep: the axon runtime can memoize repeated
     # identical jitted calls, which makes same-input timing loops lie.
+    # Array creation is LAZY through the tunnel — force each input
+    # resident (host read of a reduction) so the timed window is
+    # compute-only, not host->device transfer.
     inputs = [
         (
             jnp.asarray(rng.standard_normal(n), jnp.float32),
@@ -36,6 +39,8 @@ def _tpu_pairs_per_sec(n=1 << 20, tile_a=2048, tile_b=8192, reps=3):
         )
         for _ in range(reps + 1)
     ]
+    for a, b in inputs:
+        float(jnp.sum(a) + jnp.sum(b))
 
     # Prefer the hand-tiled Pallas kernel (explicit sublane x lane layout,
     # SMEM row-block accumulators) — ~4x the lax.scan path at this size;
@@ -110,6 +115,9 @@ def _ring_pairs_per_sec(n=1 << 20, tile_a=2048, tile_b=8192, reps=3):
         )
         for _ in range(reps + 1)
     ]
+    for pa, pb in packs:  # force residency: see _tpu_pairs_per_sec
+        for arr in (*pa, *pb):
+            float(jnp.sum(arr))
 
     def f(pa, pb):
         (a, ma, ia), (b, mb, ib) = pa, pb
