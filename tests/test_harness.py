@@ -290,3 +290,36 @@ class TestMeshMC:
         r = run_variance_experiment(cfg)  # host-loop fallback still works
         assert not r["vmapped"]
         assert abs(r["mean"] - true_gaussian_auc(1.0)) < 0.05
+
+
+class TestWorkersSweep:
+    def test_tradeoff_vs_workers_shape_and_cli(self, tmp_path):
+        """Sweep returns one result per N and the variance in the
+        small-block regime exceeds the large-block one; the CLI
+        subcommand emits the same JSON."""
+        from tuplewise_tpu.harness import tradeoff_vs_workers
+
+        cfg = VarianceConfig(n_pos=96, n_neg=96, n_reps=150)
+        rs = tradeoff_vs_workers(cfg, workers=(2, 24))
+        assert [r["config"]["n_workers"] for r in rs] == [2, 24]
+        assert all(r["config"]["scheme"] == "local" for r in rs)
+        # m=48 -> near-floor; m=4 -> visibly inflated (~+25%)
+        assert rs[1]["variance"] > rs[0]["variance"]
+
+        out = subprocess.run(
+            [sys.executable, "-m", "tuplewise_tpu.harness.cli",
+             "tradeoff-workers", "--n-pos", "64", "--n-neg", "64",
+             "--n-reps", "8", "--workers", "2", "8",
+             "--out", str(tmp_path / "w.jsonl")],
+            capture_output=True, text=True, check=True,
+        )
+        lines = [json.loads(x) for x in out.stdout.splitlines() if x.strip()]
+        assert [r["config"]["n_workers"] for r in lines] == [2, 8]
+        assert (tmp_path / "w.jsonl").exists()
+
+    def test_tradeoff_vs_workers_rejects_oversubscription(self):
+        from tuplewise_tpu.harness import tradeoff_vs_workers
+
+        cfg = VarianceConfig(n_pos=96, n_neg=96, n_reps=4)
+        with pytest.raises(ValueError, match="per-class sample size"):
+            tradeoff_vs_workers(cfg, workers=(128,))

@@ -3,6 +3,7 @@ from tuplewise_tpu.harness.variance import (
     run_variance_experiment,
     tradeoff_vs_rounds,
     tradeoff_vs_pairs,
+    tradeoff_vs_workers,
 )
 from tuplewise_tpu.harness.triplet_experiment import triplet_mnist_statistic
 
@@ -11,5 +12,6 @@ __all__ = [
     "run_variance_experiment",
     "tradeoff_vs_rounds",
     "tradeoff_vs_pairs",
+    "tradeoff_vs_workers",
     "triplet_mnist_statistic",
 ]

@@ -22,6 +22,7 @@ from tuplewise_tpu.harness.variance import (
     run_variance_experiment,
     tradeoff_vs_pairs,
     tradeoff_vs_rounds,
+    tradeoff_vs_workers,
     write_jsonl,
 )
 
@@ -57,7 +58,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tuplewise-harness")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    for name in ("variance", "tradeoff-rounds", "tradeoff-pairs"):
+    for name in ("variance", "tradeoff-rounds", "tradeoff-pairs",
+                 "tradeoff-workers"):
         p = sub.add_parser(name)
         _add_variance_args(p)
         p.add_argument("--out", type=str, default=None)
@@ -72,6 +74,9 @@ def main(argv=None) -> int:
         if name == "tradeoff-pairs":
             p.add_argument("--pairs", type=int, nargs="+",
                            default=[100, 1000, 10_000, 100_000])
+        if name == "tradeoff-workers":
+            p.add_argument("--workers", type=int, nargs="+",
+                           default=[2, 8, 32, 128])
 
     p = sub.add_parser("triplet")
     p.add_argument("--kernel", default="triplet_indicator")
@@ -112,6 +117,11 @@ def main(argv=None) -> int:
         _emit(tradeoff_vs_rounds(_cfg_from_args(args), args.rounds), args.out)
     elif args.cmd == "tradeoff-pairs":
         _emit(tradeoff_vs_pairs(_cfg_from_args(args), args.pairs), args.out)
+    elif args.cmd == "tradeoff-workers":
+        _emit(
+            tradeoff_vs_workers(_cfg_from_args(args), args.workers),
+            args.out,
+        )
     elif args.cmd == "triplet":
         from tuplewise_tpu.harness.triplet_experiment import (
             triplet_mnist_statistic,

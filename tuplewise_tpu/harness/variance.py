@@ -305,6 +305,25 @@ def tradeoff_vs_pairs(cfg: VarianceConfig, pairs=(100, 1000, 10_000, 100_000)):
     return out
 
 
+def tradeoff_vs_workers(cfg: VarianceConfig, workers=(2, 8, 32)):
+    """Local-average variance vs worker count N — what local averaging
+    costs [SURVEY §1.2 item 2]. The deficit over the complete floor
+    scales ~1/m with m = n/N per-worker rows, so sweeps should push N
+    high enough that blocks get small (see RESULTS.md §3)."""
+    out = []
+    for N in workers:
+        if N > min(cfg.n_pos, cfg.n_neg):
+            # m = n // N would be 0: empty blocks -> NaN estimates
+            raise ValueError(
+                f"n_workers={N} exceeds the per-class sample size "
+                f"({cfg.n_pos}, {cfg.n_neg}); every worker needs at "
+                f"least one row per class"
+            )
+        c = dataclasses.replace(cfg, scheme="local", n_workers=N)
+        out.append(run_variance_experiment(c))
+    return out
+
+
 def write_jsonl(results, path: str) -> None:
     """Append results (list of dicts) as JSON lines [SURVEY §5.6]."""
     with open(path, "a") as f:
