@@ -321,5 +321,12 @@ class TestWorkersSweep:
         from tuplewise_tpu.harness import tradeoff_vs_workers
 
         cfg = VarianceConfig(n_pos=96, n_neg=96, n_reps=4)
+        # sweep validates up-front: the late bad N fails BEFORE any
+        # compute is spent on the early good ones
         with pytest.raises(ValueError, match="per-class sample size"):
-            tradeoff_vs_workers(cfg, workers=(128,))
+            tradeoff_vs_workers(cfg, workers=(2, 128))
+        # every entry point is guarded, not just the sweep wrapper
+        with pytest.raises(ValueError, match="per-class sample size"):
+            run_variance_experiment(
+                dataclasses.replace(cfg, scheme="local", n_workers=128)
+            )

@@ -207,6 +207,14 @@ def run_variance_experiment(
         raise ValueError(
             f"unknown scheme {cfg.scheme!r}; choose one of {_SCHEMES}"
         )
+    if (cfg.scheme in ("local", "repartitioned")
+            and cfg.n_workers > min(cfg.n_pos, cfg.n_neg)):
+        # m = n // N would be 0: empty worker blocks -> NaN estimates
+        raise ValueError(
+            f"n_workers={cfg.n_workers} exceeds the per-class sample "
+            f"size ({cfg.n_pos}, {cfg.n_neg}); every worker needs at "
+            f"least one row per class"
+        )
 
     from tuplewise_tpu.utils.checkpoint import (
         iter_chunks, resume_progress, save_checkpoint,
@@ -310,15 +318,16 @@ def tradeoff_vs_workers(cfg: VarianceConfig, workers=(2, 8, 32)):
     costs [SURVEY §1.2 item 2]. The deficit over the complete floor
     scales ~1/m with m = n/N per-worker rows, so sweeps should push N
     high enough that blocks get small (see RESULTS.md §3)."""
+    bad = [N for N in workers if N > min(cfg.n_pos, cfg.n_neg)]
+    if bad:
+        # validate the whole sweep BEFORE spending compute on any of it
+        raise ValueError(
+            f"worker counts {bad} exceed the per-class sample size "
+            f"({cfg.n_pos}, {cfg.n_neg}); every worker needs at least "
+            f"one row per class"
+        )
     out = []
     for N in workers:
-        if N > min(cfg.n_pos, cfg.n_neg):
-            # m = n // N would be 0: empty blocks -> NaN estimates
-            raise ValueError(
-                f"n_workers={N} exceeds the per-class sample size "
-                f"({cfg.n_pos}, {cfg.n_neg}); every worker needs at "
-                f"least one row per class"
-            )
         c = dataclasses.replace(cfg, scheme="local", n_workers=N)
         out.append(run_variance_experiment(c))
     return out
