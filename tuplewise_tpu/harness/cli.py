@@ -3,6 +3,7 @@
     python -m tuplewise_tpu.harness.cli variance --scheme repartitioned --n-rounds 4
     python -m tuplewise_tpu.harness.cli tradeoff-rounds --n-reps 200 --out results.jsonl
     python -m tuplewise_tpu.harness.cli tradeoff-pairs
+    python -m tuplewise_tpu.harness.cli tradeoff-workers --workers 8 1000 125000
     python -m tuplewise_tpu.harness.cli triplet --n 2000
     python -m tuplewise_tpu.harness.cli train --dataset adult --steps 100
 
