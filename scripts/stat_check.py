@@ -1,0 +1,116 @@
+"""Statistical verification of every committed result row.
+
+Reads all results/*.jsonl variance-harness rows and checks, per row:
+
+  * mean vs the population AUC (z = (mean - pop) / SE(mean)), and
+  * variance vs its Hoeffding closed form
+    (z = (var - pred) / SE(var), SE(var) ~ var * sqrt(2/(M-1)) for
+    near-Gaussian estimator distributions),
+
+with plug-in zetas from a 20k-per-class sample (`estimators/variance`).
+Writes results/stat_check.txt and exits nonzero if any |z| > 4 — a
+one-file audit that the committed experiments obey the theory, and a
+regression gate future rounds can run after touching any estimator.
+
+Usage: python scripts/stat_check.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tuplewise_tpu.data import make_gaussians, true_gaussian_auc  # noqa: E402
+from tuplewise_tpu.estimators.variance import (  # noqa: E402
+    two_sample_variance_from_zetas, two_sample_zetas,
+)
+
+Z_LIMIT = 4.0
+_ZETAS = {}
+
+
+def zetas(kernel: str, separation: float):
+    key = (kernel, separation)
+    if key not in _ZETAS:
+        X, Y = make_gaussians(20_000, 20_000, 1, separation, seed=7)
+        _ZETAS[key] = two_sample_zetas(kernel, X[:, 0], Y[:, 0])
+    return _ZETAS[key]
+
+
+def predicted_variance(cfg: dict) -> float | None:
+    """Closed-form Var for a harness row, or None if no formula applies
+    (feature kernels, non-Gaussian data paths)."""
+    if cfg["kernel"] != "auc" or cfg["dim"] != 1:
+        return None
+    z = zetas(cfg["kernel"], cfg["separation"])
+    n1, n2, N = cfg["n_pos"], cfg["n_neg"], cfg["n_workers"]
+    vc = two_sample_variance_from_zetas(z, n1, n2)
+    if cfg["scheme"] == "complete":
+        return vc
+    if cfg["scheme"] in ("local", "repartitioned"):
+        v_loc = two_sample_variance_from_zetas(z, n1 // N, n2 // N) / N
+        if cfg["scheme"] == "local":
+            return v_loc
+        return vc + max(v_loc - vc, 0.0) / cfg["n_rounds"]
+    if cfg["scheme"] == "incomplete":
+        return vc + (z[2] - vc) / cfg["n_pairs"]
+    return None
+
+
+def main() -> int:
+    rows, worst = [], 0.0
+    paths = sorted(glob.glob(os.path.join(REPO, "results", "*.jsonl")))
+    for path in paths:
+        name = os.path.basename(path)
+        if name == "configs.jsonl":  # not harness rows
+            continue
+        for line in open(path):
+            r = json.loads(line)
+            cfg, M = r.get("config"), r.get("n_reps")
+            if not cfg or not M or M < 8:
+                continue
+            pop = true_gaussian_auc(cfg["separation"])
+            z_mean = (r["mean"] - pop) / math.sqrt(r["variance"] / M)
+            pred = predicted_variance(cfg)
+            z_var = (
+                (r["variance"] - pred)
+                / (pred * math.sqrt(2.0 / (M - 1)))
+                if pred else float("nan")
+            )
+            worst = max(worst, abs(z_mean),
+                        abs(z_var) if pred else 0.0)
+            rows.append(
+                f"{name:<28} {cfg['scheme']:>13} N={cfg['n_workers']:<7}"
+                f"T={cfg['n_rounds']:<3} B={cfg['n_pairs']:<9}"
+                f"n={cfg['n_pos']:<8} M={M:<4}"
+                f" mean={r['mean']:.6f} z_mean={z_mean:+5.2f}"
+                + (f" var={r['variance']:.3e} pred={pred:.3e}"
+                   f" z_var={z_var:+5.2f}" if pred else " (no closed form)")
+            )
+    ok = worst <= Z_LIMIT
+    header = (
+        f"Statistical audit of committed results ({len(rows)} rows): "
+        f"worst |z| = {worst:.2f} (limit {Z_LIMIT}) -> "
+        f"{'PASS' if ok else 'FAIL'}\n"
+        "z_mean: estimator mean vs population AUC; z_var: Monte-Carlo "
+        "variance vs Hoeffding closed form (plug-in zetas, 20k sample).\n"
+    )
+    report = header + "\n".join(rows) + "\n"
+    out = os.path.join(REPO, "results", "stat_check.txt")
+    with open(out, "w") as f:
+        f.write(report)
+    print(report)
+    print(f"# wrote {out}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -330,3 +330,21 @@ class TestWorkersSweep:
             run_variance_experiment(
                 dataclasses.replace(cfg, scheme="local", n_workers=128)
             )
+
+
+def test_committed_results_pass_statistical_audit():
+    """Every committed results/*.jsonl harness row must sit within
+    |z| <= 4 of its Hoeffding closed form (scripts/stat_check.py) —
+    the theory-vs-artifact regression gate."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo, "results")):
+        pytest.skip("no committed results directory")
+    spec = importlib.util.spec_from_file_location(
+        "stat_check", os.path.join(repo, "scripts", "stat_check.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
