@@ -30,7 +30,11 @@ sys.path.insert(0, REPO)
 
 from tuplewise_tpu.data import make_gaussians, true_gaussian_auc  # noqa: E402
 from tuplewise_tpu.estimators.variance import (  # noqa: E402
-    two_sample_variance_from_zetas, two_sample_zetas,
+    incomplete_variance_from_zetas,
+    local_variance_from_zetas,
+    repartitioned_variance_from_zetas,
+    two_sample_variance_from_zetas,
+    two_sample_zetas,
 )
 
 Z_LIMIT = 4.0
@@ -52,30 +56,34 @@ def predicted_variance(cfg: dict) -> float | None:
         return None
     z = zetas(cfg["kernel"], cfg["separation"])
     n1, n2, N = cfg["n_pos"], cfg["n_neg"], cfg["n_workers"]
-    vc = two_sample_variance_from_zetas(z, n1, n2)
     if cfg["scheme"] == "complete":
-        return vc
-    if cfg["scheme"] in ("local", "repartitioned"):
-        v_loc = two_sample_variance_from_zetas(z, n1 // N, n2 // N) / N
-        if cfg["scheme"] == "local":
-            return v_loc
-        return vc + max(v_loc - vc, 0.0) / cfg["n_rounds"]
+        return two_sample_variance_from_zetas(z, n1, n2)
+    if cfg["scheme"] == "local":
+        return local_variance_from_zetas(z, n1, n2, n_workers=N)
+    if cfg["scheme"] == "repartitioned":
+        return repartitioned_variance_from_zetas(
+            z, n1, n2, n_workers=N, n_rounds=cfg["n_rounds"]
+        )
     if cfg["scheme"] == "incomplete":
-        return vc + (z[2] - vc) / cfg["n_pairs"]
+        return incomplete_variance_from_zetas(
+            z, n1, n2, n_pairs=cfg["n_pairs"]
+        )
     return None
 
 
-def main() -> int:
+def main(out: str | None = None) -> int:
     rows, worst = [], 0.0
     paths = sorted(glob.glob(os.path.join(REPO, "results", "*.jsonl")))
     for path in paths:
         name = os.path.basename(path)
-        if name == "configs.jsonl":  # not harness rows
-            continue
         for line in open(path):
             r = json.loads(line)
             cfg, M = r.get("config"), r.get("n_reps")
-            if not cfg or not M or M < 8:
+            # only harness rows qualify: a dict config with the
+            # variance-experiment schema (summary files like
+            # configs.jsonl carry scalar 'config' ids)
+            if (not isinstance(cfg, dict) or not M or M < 8
+                    or "scheme" not in cfg or "separation" not in cfg):
                 continue
             pop = true_gaussian_auc(cfg["separation"])
             z_mean = (r["mean"] - pop) / math.sqrt(r["variance"] / M)
@@ -104,7 +112,7 @@ def main() -> int:
         "variance vs Hoeffding closed form (plug-in zetas, 20k sample).\n"
     )
     report = header + "\n".join(rows) + "\n"
-    out = os.path.join(REPO, "results", "stat_check.txt")
+    out = out or os.path.join(REPO, "results", "stat_check.txt")
     with open(out, "w") as f:
         f.write(report)
     print(report)

@@ -135,6 +135,34 @@ def _local_var(kernel, zetas, sizes, n_workers: int) -> float:
     return _complete_var(kernel, zetas, per) / n_workers
 
 
+def local_variance_from_zetas(zetas, n1, n2, *, n_workers: int) -> float:
+    """Zeta-level Var(U^loc_N) for two-sample statistics — the single
+    source of truth shared by the data-level API and the results audit
+    (scripts/stat_check.py)."""
+    per = (n1 // n_workers, n2 // n_workers)
+    if min(per) < 2:
+        raise ValueError(
+            f"n_workers={n_workers} leaves per-worker sizes {per}; need "
+            "at least 2 rows per worker and class"
+        )
+    return two_sample_variance_from_zetas(zetas, *per) / n_workers
+
+
+def repartitioned_variance_from_zetas(
+    zetas, n1, n2, *, n_workers: int, n_rounds: int
+) -> float:
+    """Zeta-level Var(U_{N,T}): complete floor + deficit / T."""
+    vc = two_sample_variance_from_zetas(zetas, n1, n2)
+    v_loc = local_variance_from_zetas(zetas, n1, n2, n_workers=n_workers)
+    return vc + max(v_loc - vc, 0.0) / n_rounds
+
+
+def incomplete_variance_from_zetas(zetas, n1, n2, *, n_pairs: int) -> float:
+    """Zeta-level Var(U~_B): Var(U_n) + (zeta_11 - Var(U_n)) / B."""
+    vc = two_sample_variance_from_zetas(zetas, n1, n2)
+    return vc + (zetas[-1] - vc) / n_pairs
+
+
 def incomplete_variance(kernel, A, B=None, *, n_pairs: int) -> float:
     """Var of the incomplete U-statistic with B tuples drawn with
     replacement: Var(U_n) + (zeta_11 - Var(U_n)) / B [SURVEY §1.1]."""
