@@ -349,3 +349,17 @@ def test_committed_results_pass_statistical_audit(tmp_path):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main(out=str(tmp_path / "stat_check.txt")) == 0
+
+
+def test_frontier_figure(tmp_path):
+    from tuplewise_tpu.harness.figures import plot_frontier
+
+    cfg = VarianceConfig(n_pos=128, n_neg=128, n_reps=20)
+    comp = run_variance_experiment(cfg)
+    inc = tradeoff_vs_pairs(cfg, pairs=(100, 1000))
+    p = plot_frontier(
+        {"complete": [comp], "incomplete": inc}, str(tmp_path / "f.png")
+    )
+    import os
+
+    assert os.path.getsize(p) > 1000

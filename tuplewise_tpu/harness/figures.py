@@ -143,3 +143,33 @@ def plot_learning_curve(history, out_png: str,
     fig.savefig(out_png, dpi=150)
     plt.close(fig)
     return out_png
+
+
+def plot_frontier(groups, out_png: str) -> str:
+    """The headline axis in one picture [BASELINE.json:2]: estimator
+    variance vs wall-clock per estimate for every scheme family.
+    ``groups`` maps a series label to a list of harness result dicts;
+    each point is one committed experiment."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(5.5, 4))
+    markers = {"complete": "*", "incomplete": "o", "repartitioned": "s",
+               "local": "D"}
+    for label, rs in groups.items():
+        rs = _results(rs)
+        wc = [r["wallclock_s"] / r["n_reps"] for r in rs]
+        var = [r["variance"] for r in rs]
+        scheme = rs[0]["config"]["scheme"]
+        ax.loglog(wc, var, markers.get(scheme, "o"),
+                  ls="-" if len(rs) > 1 else "",
+                  ms=9 if scheme == "complete" else 5, label=label)
+    ax.set_xlabel("wall-clock per estimate [s]")
+    ax.set_ylabel("estimator variance")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png
