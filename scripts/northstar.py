@@ -198,8 +198,9 @@ def main():
     if "figs" in stages:
         log("== stage figures ==")
         from tuplewise_tpu.harness.figures import (
-            plot_variance_vs_pairs, plot_variance_vs_rounds,
-            plot_variance_vs_wallclock, plot_variance_vs_workers,
+            plot_frontier, plot_variance_vs_pairs,
+            plot_variance_vs_rounds, plot_variance_vs_wallclock,
+            plot_variance_vs_workers,
         )
 
         def load(name):
@@ -230,6 +231,19 @@ def main():
             if pairs:
                 plot_variance_vs_pairs(
                     pairs, os.path.join(figs, f"var_vs_pairs_{scale}.png"),
+                )
+            if var or rounds or pairs:
+                plot_frontier(
+                    {
+                        "complete $U_n$": [comp] if comp else [],
+                        "local average": [
+                            r for r in var
+                            if r["config"]["scheme"] == "local"
+                        ],
+                        "repartitioned T=1..": rounds,
+                        "incomplete B sweep": pairs,
+                    },
+                    os.path.join(figs, f"frontier_{scale}.png"),
                 )
         # trade-off-regime figures with the closed-form overlay
         tthe = {}

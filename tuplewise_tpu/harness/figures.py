@@ -74,6 +74,13 @@ def plot_variance_vs_workers(results, out_png: str,
     )
 
 
+def _wc_var(rs):
+    """(wall-clock per estimate, variance) series for a result list —
+    the one place the per-estimate normalization lives."""
+    return ([r["wallclock_s"] / r["n_reps"] for r in rs],
+            [r["variance"] for r in rs])
+
+
 def plot_variance_vs_wallclock(results, out_png: str) -> str:
     """Variance vs wall-clock — the headline trade-off axis
     (BASELINE.json:2)."""
@@ -83,8 +90,7 @@ def plot_variance_vs_wallclock(results, out_png: str) -> str:
     import matplotlib.pyplot as plt
 
     rs = _results(results)
-    wc = [r["wallclock_s"] / r["n_reps"] for r in rs]
-    var = [r["variance"] for r in rs]
+    wc, var = _wc_var(rs)
     labels = [str(r["config"].get("n_rounds", "")) for r in rs]
     fig, ax = plt.subplots(figsize=(5, 3.5))
     ax.loglog(wc, var, "o-")
@@ -160,8 +166,9 @@ def plot_frontier(groups, out_png: str) -> str:
                "local": "D"}
     for label, rs in groups.items():
         rs = _results(rs)
-        wc = [r["wallclock_s"] / r["n_reps"] for r in rs]
-        var = [r["variance"] for r in rs]
+        if not rs:  # tolerate not-yet-populated series
+            continue
+        wc, var = _wc_var(rs)
         scheme = rs[0]["config"]["scheme"]
         ax.loglog(wc, var, markers.get(scheme, "o"),
                   ls="-" if len(rs) > 1 else "",
