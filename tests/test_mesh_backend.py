@@ -35,6 +35,17 @@ class TestRingInvariance:
         ref = Estimator("auc", backend="numpy").complete(s1, s2)
         assert abs(mesh_est.complete(s1, s2) - ref) < 1e-6
 
+    @pytest.mark.parametrize("n_workers", [2, 3, 5, 7])
+    def test_complete_any_worker_count(self, scores, n_workers):
+        """Ring rotation arithmetic holds for odd / non-power-of-2
+        worker counts, not just the 8-device default — the ppermute
+        step count and shard indexing must be N-agnostic."""
+        s1, s2 = scores
+        ref = Estimator("auc", backend="numpy").complete(s1, s2)
+        got = Estimator("auc", backend="mesh", n_workers=n_workers,
+                        tile_a=128, tile_b=128).complete(s1, s2)
+        assert abs(got - ref) < 1e-6
+
     def test_complete_ragged_sizes(self, scores, mesh_est):
         """Sizes not divisible by 8 exercise pad+mask inside the ring."""
         s1, s2 = scores
