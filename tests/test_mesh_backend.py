@@ -73,6 +73,45 @@ class TestRingInvariance:
                         impl="pallas").complete(s1, s2)
         assert abs(got - ref) < 1e-6
 
+    def test_complete_pallas_ring_unmasked_fast_path(self, scores):
+        """Padding-free, tile-divisible shapes dispatch the ring to the
+        UNMASKED Pallas kernel [VERDICT r2 next #3] and still reproduce
+        the oracle. The dispatch itself is asserted structurally below
+        (test_fast_path_dispatch); this pins the value."""
+        s1, s2 = scores
+        s1, s2 = s1[:2048], s2[:1024]    # m=256/128, tiles divide
+        ref = Estimator("auc", backend="numpy").complete(s1, s2)
+        got = Estimator("auc", backend="mesh", n_workers=8,
+                        tile_a=128, tile_b=128,
+                        impl="pallas").complete(s1, s2)
+        assert abs(got - ref) < 1e-6
+
+    def test_fast_path_dispatch(self):
+        """_make_stats_fn picks the unmasked kernel exactly when the
+        caller certifies no masks AND the block divides the tiles; any
+        violation falls back to the masked kernel."""
+        from tuplewise_tpu.ops.kernels import auc_kernel
+        from tuplewise_tpu.parallel.ring import _make_stats_fn
+
+        def build(**kw):
+            base = dict(
+                tile_a=128, tile_b=128, use_ids=False, impl="pallas",
+                interpret=True, no_masks=True, n_a=256, n_b=128,
+            )
+            base.update(kw)
+            return _make_stats_fn(auc_kernel, None, None, **base)
+
+        assert build().__name__ == "fast_stats_fn"
+        # ragged block, mask present, ids, or xla impl -> masked/XLA path
+        assert build(n_a=250).__name__ != "fast_stats_fn"
+        assert build(n_b=120).__name__ != "fast_stats_fn"
+        assert build(no_masks=False).__name__ != "fast_stats_fn"
+        assert build(impl="xla").__name__ != "fast_stats_fn"
+        # SMEM budget: tile_a doubles to fit (still fast) ...
+        assert build(n_a=1 << 20, tile_a=128).__name__ == "fast_stats_fn"
+        # ... but a non-power-of-2 n_a with no conforming doubling bails
+        assert build(n_a=3 * 125000, tile_a=8).__name__ != "fast_stats_fn"
+
     def test_triplet_complete_double_ring(self):
         rng = np.random.default_rng(1)
         X = rng.standard_normal((48, 3))

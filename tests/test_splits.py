@@ -1,0 +1,153 @@
+"""Held-out evaluation plumbing [SURVEY §3 "Evaluation"; VERDICT r2
+next #2]: stratified splits are disjoint/seeded/class-preserving, the
+canonical adult.data/adult.test pair is used when present, and
+standardization never sees the test side."""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.data import (
+    load_adult_splits,
+    make_gaussian_splits,
+    standardize_pair,
+    stratified_split,
+)
+from tests.test_loaders import _ADULT_ROW, _write_adult
+
+
+class TestStratifiedSplit:
+    def test_disjoint_and_complete(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((100, 3))
+        y = (rng.random(100) < 0.3).astype(int)
+        (Xtr, ytr), (Xte, yte) = stratified_split(X, y, 0.25, seed=1)
+        assert len(Xtr) + len(Xte) == 100
+        # every row lands on exactly one side
+        allrows = np.concatenate([Xtr, Xte])
+        assert np.array_equal(
+            np.sort(allrows, axis=0), np.sort(X, axis=0)
+        )
+
+    def test_stratified_proportions(self):
+        y = np.array([0] * 80 + [1] * 20)
+        X = np.arange(100, dtype=float)[:, None]
+        (_, ytr), (_, yte) = stratified_split(X, y, 0.25, seed=0)
+        assert (yte == 1).sum() == 5      # round(0.25 * 20)
+        assert (yte == 0).sum() == 20     # round(0.25 * 80)
+        assert (ytr == 1).sum() == 15
+
+    def test_seeded_reproducible(self):
+        rng = np.random.default_rng(2)
+        X = rng.standard_normal((50, 2))
+        y = (rng.random(50) < 0.5).astype(int)
+        a = stratified_split(X, y, 0.3, seed=7)
+        b = stratified_split(X, y, 0.3, seed=7)
+        assert np.array_equal(a[0][0], b[0][0])
+        assert np.array_equal(a[1][0], b[1][0])
+        c = stratified_split(X, y, 0.3, seed=8)
+        assert not np.array_equal(a[1][0], c[1][0])
+
+    def test_tiny_class_keeps_both_sides(self):
+        X = np.arange(12, dtype=float)[:, None]
+        y = np.array([0] * 10 + [1] * 2)
+        (_, ytr), (_, yte) = stratified_split(X, y, 0.25, seed=0)
+        assert (ytr == 1).sum() == 1 and (yte == 1).sum() == 1
+
+    def test_singleton_class_raises(self):
+        X = np.zeros((3, 1))
+        y = np.array([0, 0, 1])
+        with pytest.raises(ValueError, match="class"):
+            stratified_split(X, y, 0.25, seed=0)
+
+    def test_bad_fraction_raises(self):
+        X, y = np.zeros((4, 1)), np.array([0, 0, 1, 1])
+        with pytest.raises(ValueError, match="test_fraction"):
+            stratified_split(X, y, 1.5, seed=0)
+
+
+class TestStandardizePair:
+    def test_train_stats_only(self):
+        rng = np.random.default_rng(3)
+        Xtr = rng.standard_normal((200, 4)) * 3.0 + 1.0
+        Xte = rng.standard_normal((50, 4)) * 5.0 - 2.0
+        Str, Ste = standardize_pair(Xtr, Xte)
+        assert np.allclose(Str.mean(0), 0, atol=1e-9)
+        assert np.allclose(Str.std(0), 1, atol=1e-9)
+        # test side transformed with TRAIN stats, not its own
+        mu, sd = Xtr.mean(0), Xtr.std(0) + 1e-12
+        assert np.allclose(Ste, (Xte - mu) / sd)
+
+
+class TestLoadAdultSplits:
+    def test_uses_canonical_test_file(self, tmp_path, monkeypatch):
+        _write_adult(tmp_path / "adult.data", n=40)
+        # adult.test rows carry the trailing-dot label convention
+        (tmp_path / "adult.test").write_text("\n".join(
+            _ADULT_ROW.format(
+                age=25 + i, work="Private", sex="Male", hours=35,
+                label=">50K." if i % 2 else "<=50K.",
+            ) for i in range(10)
+        ) + "\n")
+        monkeypatch.setenv("TUPLEWISE_DATA_DIR", str(tmp_path))
+        Xtr, ytr, Xte, yte, meta = load_adult_splits(n=30, seed=0)
+        assert meta["split"] == "adult.test"
+        assert meta["synthetic"] is False
+        assert len(Xtr) == 30 and len(Xte) == 10
+        assert Xtr.shape[1] == Xte.shape[1]      # canonical alignment
+        assert set(yte) == {0, 1}
+        # standardization fit on train only
+        assert np.allclose(Xtr.mean(0), 0, atol=1e-9)
+
+    def test_surrogate_fallback_splits(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TUPLEWISE_DATA_DIR", str(tmp_path / "none"))
+        Xtr, ytr, Xte, yte, meta = load_adult_splits(
+            n=400, seed=0, test_fraction=0.25
+        )
+        assert meta["synthetic"] is True
+        assert meta["split"] == "stratified"
+        assert len(Xtr) + len(Xte) == 400
+        assert abs(len(Xte) / 400 - 0.25) < 0.02
+        assert set(ytr) == {0, 1} and set(yte) == {0, 1}
+
+    def test_single_real_file_falls_back_to_stratified(
+        self, tmp_path, monkeypatch
+    ):
+        _write_adult(tmp_path / "adult.data", n=40)
+        monkeypatch.setenv("TUPLEWISE_DATA_DIR", str(tmp_path))
+        Xtr, ytr, Xte, yte, meta = load_adult_splits(n=40, seed=0)
+        assert meta["synthetic"] is False
+        assert meta["split"] == "stratified"
+        assert len(Xtr) + len(Xte) == 40
+
+
+class TestGaussianSplits:
+    def test_disjoint_fresh_draws(self):
+        Xp, Xn, Xp_te, Xn_te = make_gaussian_splits(
+            100, 30, dim=4, separation=1.0, seed=0
+        )
+        assert Xp.shape == (100, 4) and Xp_te.shape == (30, 4)
+        assert Xn.shape == (100, 4) and Xn_te.shape == (30, 4)
+        # same underlying draw, positionally disjoint
+        assert not np.isin(
+            Xp_te.ravel(), Xp.ravel()
+        ).any()
+
+
+def test_cli_train_reports_test_auc(tmp_path, monkeypatch, capsys):
+    """The train subcommand trains on the train split and reports both
+    train and held-out AUC [VERDICT r2 weak #1]."""
+    import json
+
+    from tuplewise_tpu.harness.cli import main
+
+    monkeypatch.setenv("TUPLEWISE_DATA_DIR", str(tmp_path / "none"))
+    rc = main([
+        "train", "--dataset", "gaussians", "--n", "256",
+        "--steps", "5", "--kernel", "hinge", "--seed", "0",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    for key in ("auc_train", "auc_test", "auc_train_before",
+                "auc_test_before"):
+        assert key in rec and 0.0 <= rec[key] <= 1.0
+    assert rec["auc_test"] > rec["auc_test_before"]

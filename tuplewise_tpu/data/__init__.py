@@ -1,9 +1,19 @@
 from tuplewise_tpu.data.synthetic import make_gaussians, true_gaussian_auc
 from tuplewise_tpu.data.loaders import load_adult, load_mnist_embeddings
+from tuplewise_tpu.data.splits import (
+    load_adult_splits,
+    make_gaussian_splits,
+    standardize_pair,
+    stratified_split,
+)
 
 __all__ = [
     "make_gaussians",
     "true_gaussian_auc",
     "load_adult",
+    "load_adult_splits",
     "load_mnist_embeddings",
+    "make_gaussian_splits",
+    "standardize_pair",
+    "stratified_split",
 ]

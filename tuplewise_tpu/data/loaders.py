@@ -212,10 +212,14 @@ def load_adult(
     path: Optional[str] = None,
     n: int = 32561,
     seed: int = 0,
+    standardize: bool = True,
 ) -> Tuple[np.ndarray, np.ndarray, dict]:
     """UCI Adult as a binary task: features, labels in {0, 1}.
 
-    Returns (X [n, d] float64 standardized, y [n] int, meta). Real-data
+    Returns (X [n, d] float64 standardized, y [n] int, meta); pass
+    ``standardize=False`` for raw features (the train/test split path
+    standardizes with train-side statistics instead — see
+    :mod:`tuplewise_tpu.data.splits`). Real-data
     resolution order: ``path=`` (either format) -> ``adult.npz`` (keys
     ``X``, ``y``) -> the canonical ``adult.data``/``adult.csv`` CSV
     parsed by :func:`parse_adult_csv`. With nothing on disk, generates
@@ -240,7 +244,8 @@ def load_adult(
         if len(X) > n:  # honor the requested size on real data too
             keep = np.random.default_rng(seed).choice(len(X), n, replace=False)
             X, y = X[keep], y[keep]
-        X = (X - X.mean(0)) / (X.std(0) + 1e-12)
+        if standardize:
+            X = (X - X.mean(0)) / (X.std(0) + 1e-12)
         return X, y, {"synthetic": False, "source": c}
 
     rng = np.random.default_rng(seed + 1043)
@@ -254,7 +259,8 @@ def load_adult(
     # Mild nonlinear class structure: shift + a curved component.
     X[y == 1] += 1.2 * direction * scales
     X[y == 1, 0] += 0.3 * X[y == 1, 1] ** 2 * 0.1
-    X = (X - X.mean(0)) / (X.std(0) + 1e-12)
+    if standardize:
+        X = (X - X.mean(0)) / (X.std(0) + 1e-12)
     return X, y, {"synthetic": True, "source": "surrogate(adult)"}
 
 

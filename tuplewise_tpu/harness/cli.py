@@ -136,23 +136,25 @@ def main(argv=None) -> int:
             args.out,
         )
     elif args.cmd == "train":
-        import numpy as np
-
-        from tuplewise_tpu.data import load_adult, make_gaussians
+        from tuplewise_tpu.data import load_adult_splits, make_gaussian_splits
         from tuplewise_tpu.models.pairwise_sgd import (
             TrainConfig, evaluate_auc, split_by_label, train_pairwise,
         )
         from tuplewise_tpu.models.scorers import LinearScorer
 
         if args.dataset == "adult":
-            X, y, meta = load_adult(n=args.n, seed=args.seed)
-            Xp, Xn = split_by_label(X, y)
-        else:
-            Xp, Xn = make_gaussians(
-                args.n // 2, args.n // 2, dim=5, separation=1.0,
-                seed=args.seed,
+            X, y, Xte, yte, meta = load_adult_splits(
+                n=args.n, seed=args.seed
             )
-            meta = {"synthetic": True, "source": "gaussians"}
+            Xp, Xn = split_by_label(X, y)
+            Xp_te, Xn_te = split_by_label(Xte, yte)
+        else:
+            Xp, Xn, Xp_te, Xn_te = make_gaussian_splits(
+                args.n // 2, max(args.n // 8, 64), dim=5,
+                separation=1.0, seed=args.seed,
+            )
+            meta = {"synthetic": True, "source": "gaussians",
+                    "split": "fresh_draw"}
         scorer = LinearScorer(dim=Xp.shape[1])
         p0 = scorer.init(args.seed)
         cfg = TrainConfig(
@@ -171,8 +173,10 @@ def main(argv=None) -> int:
                 "config": dataclasses.asdict(cfg),
                 "dataset": args.dataset,
                 "data_meta": meta,
-                "auc_before": evaluate_auc(scorer, p0, Xp, Xn),
-                "auc_after": evaluate_auc(scorer, params, Xp, Xn),
+                "auc_train_before": evaluate_auc(scorer, p0, Xp, Xn),
+                "auc_train": evaluate_auc(scorer, params, Xp, Xn),
+                "auc_test_before": evaluate_auc(scorer, p0, Xp_te, Xn_te),
+                "auc_test": evaluate_auc(scorer, params, Xp_te, Xn_te),
                 "loss_first": float(hist["loss"][0]),
                 "loss_last": float(hist["loss"][-1]),
             },

@@ -342,7 +342,10 @@ def split_by_label(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray
 
 
 def evaluate_auc(scorer, params, X_pos, X_neg) -> float:
-    """Rank-based test AUC of the scorer [SURVEY §3 'Evaluation']."""
+    """Rank-based AUC of the scorer on the GIVEN sample [SURVEY §3
+    'Evaluation']. It is a test AUC only when called with held-out data
+    (see :mod:`tuplewise_tpu.data.splits`); callers report train and
+    test AUC separately."""
     params = jax.tree.map(np.asarray, params)
     s1 = scorer.apply(params, np.asarray(X_pos), np)
     s2 = scorer.apply(params, np.asarray(X_neg), np)

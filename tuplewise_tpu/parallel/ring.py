@@ -39,6 +39,7 @@ def _rotate(state, axis_name):
 
 def _make_stats_fn(
     kernel, mask_a, ids_a, *, tile_a, tile_b, use_ids, impl, interpret=None,
+    no_masks=False, n_a=None, n_b=None,
 ):
     """Build the per-stop (resident, visiting) -> (sum, count) reduction.
 
@@ -50,12 +51,46 @@ def _make_stats_fn(
     checkpointed XLA tile reduction. interpret mode makes the Pallas
     path run on the CPU test mesh, so parity tests cover it; pass
     interpret explicitly when the executing mesh's platform differs
-    from the default backend (MeshBackend does)."""
+    from the default backend (MeshBackend does).
+
+    no_masks=True (with the static block sizes n_a, n_b) asserts that
+    every row on both sides is valid — no padding anywhere on the ring —
+    which is trace-time knowledge only the CALLER has (a mask array's
+    values are invisible here). When the blocks also divide the tiles,
+    the reduction dispatches to the UNMASKED Pallas kernel, skipping the
+    mask multiply the masked kernel pays on every tile (~15% of
+    throughput at the n=2^20 bench shape even with all-ones masks —
+    docs/ring_overlap.md) [VERDICT r2 next #3]."""
     if impl == "pallas" and kernel.kind == "diff" and not use_ids:
-        from tuplewise_tpu.ops.pallas_pairs import pallas_masked_pair_sum
+        from tuplewise_tpu.ops.pallas_pairs import (
+            MAX_ROW_BLOCKS, pallas_masked_pair_sum, pallas_pair_sum,
+        )
 
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
+
+        if no_masks and n_a and n_b and n_b % tile_b == 0:
+            # grow tile_a (power-of-2 doublings keep divisibility when it
+            # exists) until the SMEM row-block budget fits; bail to the
+            # masked kernel if no conforming tile exists
+            ta = tile_a
+            while ta <= n_a and n_a % ta == 0 and n_a // ta > MAX_ROW_BLOCKS:
+                ta *= 2
+            if n_a % ta == 0 and ta <= n_a and n_a // ta <= MAX_ROW_BLOCKS:
+                count = float(n_a) * float(n_b)
+
+                def fast_stats_fn(a, bv, mbv, ibv):
+                    del mbv, ibv  # every row valid by caller contract
+                    s = pallas_pair_sum(
+                        a, bv, kernel=kernel,
+                        tile_a=ta, tile_b=tile_b, interpret=interpret,
+                    )
+                    return (
+                        s.astype(a.dtype),
+                        jnp.asarray(count, a.dtype),
+                    )
+
+                return fast_stats_fn
 
         def stats_fn(a, bv, mbv, ibv):
             del ibv
@@ -141,6 +176,11 @@ def ring_pair_stats(
     Returns the SAME (sum, count) on every shard (psum'd), equal to the
     single-device pair_stats over the concatenated data — the ring
     invariance property tested in tests/test_mesh_backend.py.
+
+    Passing mask_a=mask_b=None is a trace-time PROMISE that every row is
+    valid on every shard (blocks are symmetric across the ring), which
+    unlocks the unmasked Pallas fast path when block sizes divide the
+    tiles — callers with padding anywhere must pass real masks.
     """
     if (ids_a is None) != (ids_b is None):
         raise ValueError(
@@ -156,6 +196,8 @@ def ring_pair_stats(
         kernel, mask_a, ids_a,
         tile_a=tile_a, tile_b=tile_b, use_ids=use_ids, impl=impl,
         interpret=interpret,
+        no_masks=mask_a is None and mask_b is None,
+        n_a=a.shape[0], n_b=b.shape[0],
     )
     (s, c), _ = _ring_accumulate(
         stats_fn, a, (b, mb, ib),
@@ -207,6 +249,8 @@ def ring_pair_stats_2d(
         kernel, mask_a, ids_a,
         tile_a=tile_a, tile_b=tile_b, use_ids=use_ids, impl=impl,
         interpret=interpret,
+        no_masks=mask_a is None and mask_b is None,
+        n_a=a.shape[0], n_b=b.shape[0],
     )
 
     def outer(carry, _):
