@@ -8,6 +8,10 @@ reduction on one chip (BASELINE.json:2's metric). The reference repo
 published no numbers (/root/reference was empty; BASELINE.md), so per
 SURVEY §6 the recorded baseline is the frozen NumPy oracle path measured
 on this same machine: vs_baseline = tpu_throughput / numpy_throughput.
+NOTE vs_baseline compares DIFFERENT problem sizes (TPU at n=2^20 vs the
+oracle at n=16384 — the oracle at 2^20 would take hours): it is
+round-over-round bookkeeping of the same two measurements, not a
+like-for-like speedup claim.
 
 Diagnostics go to stderr; stdout carries exactly the one JSON line.
 """

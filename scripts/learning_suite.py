@@ -1,0 +1,375 @@
+"""Learning-side trade-off suite [SURVEY §1.3, §4.4; VERDICT r2 next #1].
+
+The paper's second half: distributed pairwise SGD where repartitioning
+every n_r steps trades communication for gradient quality. Two
+instruments:
+
+* the SIMULATED-N trainer (models.sim_learner — vmap over workers AND
+  Monte-Carlo seeds, parity-tested against the mesh trainer) sweeps
+  repartition period x worker count x pair budget in the small-block
+  regime where the trade-off is visible, with honest held-out
+  evaluation (fresh-draw Gaussian test sets / stratified Adult split);
+* the MESH trainer (models.pairwise_sgd) supplies the on-hardware
+  throughput rows: steps/s at production sizes on the chip (mesh of 1)
+  and on the 8-virtual-CPU mesh (true multi-worker semantics).
+
+What the sweeps measure (and the figures show): the MEAN held-out-AUC
+learning curve per n_r, and the ACROSS-SEED variance of the final
+model — the learning analogue of the estimator's 1/T variance decay:
+a fixed partition (n_r = never) converges to a partition-dependent
+optimum whose spread across partition draws is the price of skipping
+communication; frequent repartitioning averages that randomness out
+during training. Both axes are committed per config row.
+
+Stages (platform is process-global, so chip and CPU stages are separate
+invocations):
+
+  python scripts/learning_suite.py --stages gauss,adult,mesh8,figs
+      # sim sweeps + 8-virtual-CPU mesh rows (forces the CPU platform)
+  python scripts/learning_suite.py --stages chip
+      # mesh-of-1 training throughput on the attached TPU chip
+
+Outputs: results/learning_gauss.jsonl, results/learning_adult.jsonl,
+results/learning_throughput.jsonl, results/figures/learning_*.png.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+RESULTS = os.path.join(REPO, "results")
+FIGS = os.path.join(RESULTS, "figures")
+
+T0 = time.perf_counter()
+NEVER = 1 << 30   # repartition_every sentinel for "never" (n_r = null)
+
+
+def log(msg):
+    print(f"[learning +{time.perf_counter() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_touched = set()
+
+
+def emit(rec, out_name):
+    path = os.path.join(RESULTS, out_name)
+    if path not in _touched:     # truncate once per invocation
+        _touched.add(path)
+        if os.path.exists(path):
+            os.remove(path)
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def run_config(scorer, p0, data, cfg, *, n_seeds, eval_every, dataset,
+               out_name, platform):
+    """One sweep cell: train S replicas, emit the full curve row."""
+    from tuplewise_tpu.models.sim_learner import train_curves
+
+    Xp, Xn, Xp_te, Xn_te = data
+    t0 = time.perf_counter()
+    out = train_curves(scorer, p0, Xp, Xn, Xp_te, Xn_te, cfg,
+                       n_seeds=n_seeds, eval_every=eval_every)
+    wc = time.perf_counter() - t0
+    auc = out["test_auc"]                       # [S, K]
+    fin = auc[:, -1]
+    se = auc.std(axis=0, ddof=1) / np.sqrt(n_seeds)
+    n_r = None if cfg.repartition_every >= NEVER else cfg.repartition_every
+    rec = {
+        "dataset": dataset,
+        "kernel": cfg.kernel, "lr": cfg.lr, "steps": cfg.steps,
+        "n_workers": cfg.n_workers, "n_r": n_r,
+        "repartition_every": cfg.repartition_every,
+        "pairs_per_worker": cfg.pairs_per_worker,
+        "n_seeds": n_seeds, "seed0": cfg.seed,
+        "n_train": [len(Xp), len(Xn)],
+        "n_test": [len(Xp_te), len(Xn_te)],
+        "m_per_worker": [len(Xp) // cfg.n_workers,
+                         len(Xn) // cfg.n_workers],
+        # 1 initial partition + one event per later boundary
+        "comm_events": 1 + (cfg.steps - 1) // cfg.repartition_every,
+        "eval_steps": out["steps"].tolist(),
+        "auc_mean": np.round(auc.mean(axis=0), 6).tolist(),
+        "auc_se": np.round(se, 7).tolist(),
+        "final_auc_mean": float(fin.mean()),
+        "final_auc_se": float(fin.std(ddof=1) / np.sqrt(n_seeds)),
+        "final_auc_sd": float(fin.std(ddof=1)),
+        "loss_final_mean": float(out["loss"][:, -1].mean()),
+        "wallclock_s": round(wc, 2),
+        "platform": platform,
+    }
+    emit(rec, out_name)
+    log(f"{dataset} N={cfg.n_workers} n_r={n_r} B={cfg.pairs_per_worker} "
+        f"final={rec['final_auc_mean']:.5f}+-{rec['final_auc_se']:.5f} "
+        f"sd={rec['final_auc_sd']:.5f} ({wc:.1f}s)")
+    return rec
+
+
+def stage_gauss(q, platform):
+    """Gaussians, small-block regime: n_r x N sweep + pair-budget sweep."""
+    from tuplewise_tpu.data import make_gaussian_splits
+    from tuplewise_tpu.models.pairwise_sgd import TrainConfig
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    n = 128 if q else 512
+    n_te = 2000 if q else 20000
+    steps = 40 if q else 500
+    S = 4 if q else 48
+    data = make_gaussian_splits(n, n_te, dim=10, separation=0.8, seed=0)
+    scorer = LinearScorer(dim=10)
+    p0 = scorer.init(0)
+    base = TrainConfig(kernel="hinge", lr=0.3, steps=steps, seed=1000)
+    nrs = (1, 5, NEVER) if q else (1, 5, 25, 125, NEVER)
+    for N in ((16, 32) if q else (32, 128, 256, 16)):
+        for nr in nrs:
+            run_config(
+                scorer, p0, data,
+                dataclasses.replace(base, n_workers=N,
+                                    repartition_every=nr),
+                n_seeds=S, eval_every=steps // 20 or 1,
+                dataset="gaussians", out_name="learning_gauss.jsonl",
+                platform=platform,
+            )
+    # pair-budget sweep at fixed N: stochastic per-step pair sampling
+    # composes with the repartition schedule [SURVEY §1.2 item 4].
+    # B=None (all local pairs) is not re-run: sweep A already emitted
+    # those rows at this N, and the budget figure picks them up there.
+    N = 16 if q else 128
+    for B in (1, 4, 16):
+        for nr in ((1, NEVER) if q else (1, 25, NEVER)):
+            run_config(
+                scorer, p0, data,
+                dataclasses.replace(base, n_workers=N,
+                                    repartition_every=nr,
+                                    pairs_per_worker=B),
+                n_seeds=S, eval_every=steps // 20 or 1,
+                dataset="gaussians", out_name="learning_gauss.jsonl",
+                platform=platform,
+            )
+
+
+def stage_adult(q, platform):
+    """Surrogate-Adult (real CSVs when on disk): n_r x N sweep with the
+    stratified train/test split [VERDICT r2 next #2]."""
+    from tuplewise_tpu.data import load_adult_splits
+    from tuplewise_tpu.models.pairwise_sgd import TrainConfig, split_by_label
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    n = 600 if q else 8000
+    steps = 40 if q else 400
+    S = 4 if q else 24
+    X, y, Xte, yte, meta = load_adult_splits(n=n, seed=0)
+    Xp, Xn = split_by_label(X, y)
+    Xp_te, Xn_te = split_by_label(Xte, yte)
+    data = (Xp, Xn, Xp_te, Xn_te)
+    log(f"adult: train pos/neg = {len(Xp)}/{len(Xn)}, "
+        f"test = {len(Xp_te)}/{len(Xn_te)}, source={meta['source']}")
+    scorer = LinearScorer(dim=Xp.shape[1])
+    p0 = scorer.init(0)
+    base = TrainConfig(kernel="hinge", lr=0.3, steps=steps, seed=2000)
+    nrs = (1, 5, NEVER) if q else (1, 5, 25, 125, NEVER)
+    for N in ((8,) if q else (8, 64, 180)):
+        # N=180 -> m_pos ~ 8: the visible regime at the real class ratio
+        for nr in nrs:
+            run_config(
+                scorer, p0, data,
+                dataclasses.replace(base, n_workers=N,
+                                    repartition_every=nr),
+                n_seeds=S, eval_every=steps // 20 or 1,
+                dataset="adult", out_name="learning_adult.jsonl",
+                platform=platform,
+            )
+
+
+def _throughput_row(n_per_class, cfg, label, platform, steps_timed=30,
+                    out_name="learning_throughput.jsonl"):
+    """Mesh-trainer steps/s at a production size (compile excluded)."""
+    import jax
+
+    from tuplewise_tpu.data import make_gaussian_splits
+    from tuplewise_tpu.models.pairwise_sgd import (
+        evaluate_auc, train_pairwise,
+    )
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    Xp, Xn, Xp_te, Xn_te = make_gaussian_splits(
+        n_per_class, max(n_per_class // 4, 1000), dim=5, seed=0
+    )
+    scorer = LinearScorer(dim=5)
+    p0 = scorer.init(0)
+    # warm run compiles the chunk; timed run reuses it (the compiled-
+    # chunk cache keys on cfg-sans-steps + mesh + sizes)
+    warm = dataclasses.replace(cfg, steps=2)
+    train_pairwise(scorer, p0, Xp, Xn, warm)
+    timed = dataclasses.replace(cfg, steps=steps_timed)
+    t0 = time.perf_counter()
+    params, hist = train_pairwise(scorer, p0, Xp, Xn, timed)
+    wc = time.perf_counter() - t0
+    pairs_per_step = (len(Xp) // cfg.n_workers) ** 2 * cfg.n_workers \
+        if cfg.pairs_per_worker is None \
+        else cfg.pairs_per_worker * cfg.n_workers
+    rec = {
+        "label": label, "platform": platform,
+        "devices": jax.device_count(),
+        "n_workers": cfg.n_workers,
+        "n_train_per_class": n_per_class,
+        "kernel": cfg.kernel, "lr": cfg.lr,
+        "repartition_every": cfg.repartition_every,
+        "pairs_per_worker": cfg.pairs_per_worker,
+        "steps": steps_timed,
+        "steps_per_s": round(steps_timed / wc, 3),
+        "grad_pairs_per_s": round(pairs_per_step * steps_timed / wc, 1),
+        "wallclock_s": round(wc, 3),
+        "auc_test_after": evaluate_auc(scorer, params, Xp_te, Xn_te),
+        "loss_last": float(hist["loss"][-1]),
+    }
+    emit(rec, out_name)
+    log(f"throughput {label}: {rec['steps_per_s']} steps/s, "
+        f"{rec['grad_pairs_per_s']:.3e} grad-pairs/s ({wc:.1f}s)")
+    return rec
+
+
+def stage_mesh8(q, platform):
+    """True multi-worker mesh training on the 8-virtual-CPU mesh: the
+    distributed path's semantics AND its wall-clock on record
+    [VERDICT r2 next #7]."""
+    from tuplewise_tpu.models.pairwise_sgd import TrainConfig
+
+    n = 512 if q else 4096
+    for nr in (1, 10, NEVER):
+        _throughput_row(
+            n,
+            TrainConfig(kernel="hinge", lr=0.3, n_workers=8,
+                        repartition_every=nr, seed=7),
+            label=f"mesh8_cpu_nr{'inf' if nr >= NEVER else nr}",
+            platform=platform,
+            steps_timed=10 if q else 30,
+        )
+
+
+def stage_chip(q, platform):
+    """Mesh-of-1 training on the attached TPU chip at production sizes;
+    the repartition event cost is visible as the nr=1 vs nr=inf delta."""
+    from tuplewise_tpu.models.pairwise_sgd import TrainConfig
+
+    for n in ((2048,) if q else (100_000, 500_000)):
+        for nr in (1, 10, NEVER):
+            _throughput_row(
+                n,
+                TrainConfig(kernel="hinge", lr=0.3, n_workers=1,
+                            repartition_every=nr, seed=7,
+                            tile=2048),
+                label=f"chip_n{n}_nr{'inf' if nr >= NEVER else nr}",
+                platform=platform,
+                steps_timed=5 if q else 20,
+                out_name="learning_throughput_chip.jsonl",
+            )
+
+
+def stage_figs():
+    from tuplewise_tpu.harness.figures import (
+        plot_auc_vs_budget, plot_auc_vs_comm, plot_learning_curves,
+    )
+
+    os.makedirs(FIGS, exist_ok=True)
+
+    def load(name):
+        p = os.path.join(RESULTS, name)
+        if not os.path.exists(p):
+            return []
+        with open(p) as f:
+            return [json.loads(x) for x in f if x.strip()]
+
+    for dataset, fname in (("gaussians", "learning_gauss.jsonl"),
+                           ("adult", "learning_adult.jsonl")):
+        rows = [r for r in load(fname) if r["pairs_per_worker"] is None]
+        if not rows:
+            continue
+        for N in sorted({r["n_workers"] for r in rows}):
+            sub = [r for r in rows if r["n_workers"] == N]
+            plot_learning_curves(
+                sub,
+                os.path.join(FIGS, f"learning_curves_{dataset}_N{N}.png"),
+                title=f"{dataset}, N={N} workers "
+                      f"(m={sub[0]['m_per_worker'][0]}/class)",
+            )
+        plot_auc_vs_comm(
+            rows,
+            os.path.join(FIGS, f"learning_auc_vs_comm_{dataset}.png"),
+            title=f"{dataset}: final held-out AUC vs communication",
+        )
+    # pair-budget sweep figure: B rows + the matching all-pairs rows
+    gauss = load("learning_gauss.jsonl")
+    b_rows = [r for r in gauss if r["pairs_per_worker"] is not None]
+    if b_rows:
+        N = b_rows[0]["n_workers"]
+        nrs = {r["n_r"] for r in b_rows}
+        full = [r for r in gauss if r["pairs_per_worker"] is None
+                and r["n_workers"] == N and r["n_r"] in nrs]
+        plot_auc_vs_budget(
+            b_rows + full,
+            os.path.join(FIGS, "learning_auc_vs_budget.png"),
+            title=f"gaussians, N={N}: pair budget x repartition",
+        )
+    log(f"figures written to {FIGS}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--stages", default="gauss,adult,mesh8,figs",
+                    help="comma list: gauss,adult,mesh8,chip,figs")
+    args = ap.parse_args()
+    stages = set(args.stages.split(","))
+    known = {"gauss", "adult", "mesh8", "chip", "figs"}
+    if stages - known:
+        ap.error(f"unknown stages {sorted(stages - known)}")
+    if "chip" in stages and stages & {"gauss", "adult", "mesh8"}:
+        ap.error("run --stages chip in its own invocation: the platform "
+                 "(TPU vs forced-CPU) is process-global")
+    os.makedirs(RESULTS, exist_ok=True)
+
+    if stages & {"gauss", "adult", "mesh8"}:
+        # sim sweeps + virtual mesh run on the forced-CPU platform (8
+        # virtual devices for mesh8); same conftest dance as tests/
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    else:
+        import jax
+
+        platform = jax.devices()[0].platform
+
+    if "gauss" in stages:
+        stage_gauss(args.quick, platform)
+    if "adult" in stages:
+        stage_adult(args.quick, platform)
+    if "mesh8" in stages:
+        stage_mesh8(args.quick, platform)
+    if "chip" in stages:
+        stage_chip(args.quick, platform)
+    if "figs" in stages:
+        stage_figs()
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -70,11 +70,14 @@ def run(cfg, out, chunk=None, trace_dir=None):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--stages", type=str, default="1e6,1e7,mesh,figs",
-                    help="comma list of stages to run")
+    ap.add_argument("--stages", type=str,
+                    default="1e6,1e7,tradeoff,mesh,figs",
+                    help="comma list of stages to run (the default runs "
+                         "everything RESULTS.md commits, incl. the "
+                         "visible-trade-off regime)")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
-    known = {"1e6", "1e7", "tradeoff", "mesh", "figs"}
+    known = {"1e6", "1e7", "tradeoff", "mesh", "exact", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}; "
                  f"choose from {sorted(known)}")
@@ -194,6 +197,67 @@ def main():
             trace_dir=os.path.join(RESULTS, "trace_mesh_repart"))
         run(dataclasses.replace(mesh6, scheme="local"), "mesh_n1e6.jsonl",
             chunk=None if q else 4)
+        # HBM high-water of the mesh stage (devices that report it)
+        from tuplewise_tpu.utils.profiling import device_memory_stats
+
+        for dev, stats in device_memory_stats().items():
+            log(f"memory {dev}: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(stats.items())
+                            if "bytes" in k))
+
+    if "exact" in stages:
+        # The AUC statistic has an O(n log n) EXACT path (ops.rank_auc:
+        # one sort + two searchsorteds); the frontier's complete-U
+        # wall-clock prices GENERIC-kernel streaming, which overstates
+        # the cost of exactness for this special case [VERDICT r2
+        # next #6]. Same Monte-Carlo protocol as the 1e6/1e7 stages
+        # (fresh Gaussian draws per rep, on-device), rank-AUC estimator.
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from tuplewise_tpu.ops.rank_auc import rank_auc
+        from tuplewise_tpu.utils.rng import fold, root_key
+
+        log("== stage exact (rank-AUC fast path) ==")
+        for scale, n, M in (("n1e6", n6, m6), ("n1e7", n7, m7)):
+            def one_rep(rep, n=n):
+                key = fold(root_key(0), "mc_rep", rep)
+                k1, k2 = jax.random.split(fold(key, "data"))
+                s1 = jax.random.normal(k1, (n,), jnp.float32) + 1.0
+                s2 = jax.random.normal(k2, (n,), jnp.float32)
+                return rank_auc(s1, s2)
+
+            runner = jax.jit(
+                lambda reps, f=one_rep: lax.map(f, reps)
+            )
+            np.asarray(runner(jnp.arange(2)))     # compile outside timer
+            t0 = time.perf_counter()
+            ests = np.asarray(runner(jnp.arange(M)))
+            wc = time.perf_counter() - t0
+            row = {
+                "config": {
+                    "kernel": "auc", "scheme": "complete",
+                    "estimator": "rank_auc_exact", "backend": "jax",
+                    "n_pos": n, "n_neg": n, "dim": 1,
+                    "separation": 1.0, "n_workers": 1, "n_rounds": 1,
+                    "n_pairs": 0, "partition_scheme": "swor",
+                    "n_reps": M, "seed": 0,
+                },
+                "mean": float(ests.mean()),
+                "variance": float(ests.var(ddof=1)),
+                "std_error": float(ests.std(ddof=1) / np.sqrt(M)),
+                "wallclock_s": wc,
+                "vmapped": True,
+                "n_reps": M,
+            }
+            path = os.path.join(RESULTS, f"exact_{scale}.jsonl")
+            if os.path.exists(path):
+                os.remove(path)
+            write_jsonl([row], path)
+            log(f"exact_{scale}: var={row['variance']:.3e} "
+                f"wc={wc:.3f}s for M={M} ({wc / M * 1e3:.1f} ms/rep)")
 
     if "figs" in stages:
         log("== stage figures ==")
@@ -215,6 +279,7 @@ def main():
             rounds = load(f"rounds_{scale}.jsonl")
             var = load(f"variance_{scale}.jsonl")
             pairs = load(f"pairs_{scale}.jsonl")
+            exact = load(f"exact_{scale}.jsonl")
             comp = next(
                 (r for r in var if r["config"]["scheme"] == "complete"),
                 None,
@@ -235,13 +300,19 @@ def main():
             if var or rounds or pairs:
                 plot_frontier(
                     {
-                        "complete $U_n$": [comp] if comp else [],
+                        "complete $U_n$ (generic streaming)":
+                            [comp] if comp else [],
                         "local average": [
                             r for r in var
                             if r["config"]["scheme"] == "local"
                         ],
                         "repartitioned T=1..": rounds,
                         "incomplete B sweep": pairs,
+                        # the AUC special case has an O(n log n) exact
+                        # path — without this point the figure reads as
+                        # "exactness costs 47 s", which is only true of
+                        # generic kernels [VERDICT r2 next #6]
+                        "exact rank-AUC ($O(n\\log n)$)": exact,
                     },
                     os.path.join(figs, f"frontier_{scale}.png"),
                 )

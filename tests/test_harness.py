@@ -281,15 +281,101 @@ class TestMeshMC:
         ratio = rm["variance"] / rj["variance"]
         assert 0.5 < ratio < 2.0, (rm["variance"], rj["variance"])
 
-    def test_fallback_when_not_divisible(self):
+    @pytest.mark.parametrize(
+        "scheme", ["complete", "local", "repartitioned", "incomplete"]
+    )
+    def test_ragged_sizes_stay_on_device(self, scheme):
+        """N that does not divide n runs mask-aware on device now
+        [VERDICT r2 next #5] — no host-loop fallback, still unbiased."""
         self._needs_mesh()
         cfg = VarianceConfig(
-            backend="mesh", scheme="complete", n_pos=515, n_neg=512,
-            n_workers=8, n_reps=8,
+            backend="mesh", scheme=scheme, n_pos=515, n_neg=509,
+            n_workers=8, n_rounds=2, n_pairs=4096, n_reps=48,
         )
-        r = run_variance_experiment(cfg)  # host-loop fallback still works
-        assert not r["vmapped"]
-        assert abs(r["mean"] - true_gaussian_auc(1.0)) < 0.05
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"], "ragged mesh config fell back to host loop"
+        assert abs(r["mean"] - true_gaussian_auc(1.0)) < (
+            5 * r["std_error"] + 1e-3
+        )
+
+    @pytest.mark.parametrize(
+        "scheme", ["complete", "local", "repartitioned", "incomplete"]
+    )
+    def test_scatter_feature_kernel_on_device(self, scheme):
+        """One-sample feature kernels (scatter) run mesh-native with
+        global-id pair exclusion [VERDICT r2 next #5]: the mean must
+        match the population value E h = E||X-X'||^2 / 2 = dim for unit
+        Gaussians (dim=1 here; the class shift cancels in
+        differences)."""
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            kernel="scatter", backend="mesh", scheme=scheme,
+            n_pos=512, n_neg=512, n_workers=8, n_rounds=2,
+            n_pairs=4096, n_reps=48,
+        )
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"], "scatter mesh config fell back to host loop"
+        assert abs(r["mean"] - 1.0) < 5 * r["std_error"] + 0.02
+
+    def test_scatter_matches_host_loop_distribution(self):
+        """Mesh-native scatter draws from the same distribution as the
+        host-loop mesh Estimator (same semantics, different fold
+        chains): means agree within combined MC error."""
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            kernel="scatter", backend="mesh", scheme="complete",
+            n_pos=160, n_neg=160, n_workers=8, n_reps=24,
+        )
+        r_dev = run_variance_experiment(cfg)
+        assert r_dev["vmapped"]
+        # host loop over the public Estimator API (the old fallback)
+        from tuplewise_tpu.estimators.estimator import Estimator
+        from tuplewise_tpu.harness.variance import _estimate_once
+
+        est = Estimator("scatter", backend="mesh", n_workers=8)
+        host = [
+            _estimate_once(est, cfg, rep) for rep in range(24)
+        ]
+        se = (r_dev["variance"] / 24 + np.var(host, ddof=1) / 24) ** 0.5
+        assert abs(r_dev["mean"] - np.mean(host)) < 5 * se + 1e-3
+
+    def test_2d_mesh_runner(self):
+        """A 2-D (dcn x ici) mesh compiles and reproduces the 1-D
+        runner's estimates distributionally [VERDICT r2 next #5]; the
+        complete scheme is deterministic given data, so means match the
+        flat-mesh complete at matched n within MC error."""
+        self._needs_mesh()
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
+        from tuplewise_tpu.parallel.mesh import make_mesh_2d
+
+        cfg = VarianceConfig(
+            backend="mesh", scheme="complete", n_pos=512, n_neg=512,
+            n_workers=8, n_reps=16,
+        )
+        run2d = make_mesh_mc_runner(cfg, mesh=make_mesh_2d(2, 4))
+        assert run2d is not None, "2-D mesh returned no runner"
+        ests = np.asarray(run2d(jnp.arange(16)))
+        se = ests.std(ddof=1) / 4
+        assert abs(ests.mean() - true_gaussian_auc(1.0)) < 5 * se + 1e-3
+
+    def test_2d_mesh_ragged_local(self):
+        self._needs_mesh()
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
+        from tuplewise_tpu.parallel.mesh import make_mesh_2d
+
+        cfg = VarianceConfig(
+            backend="mesh", scheme="local", n_pos=515, n_neg=509,
+            n_workers=8, n_reps=16,
+        )
+        run2d = make_mesh_mc_runner(cfg, mesh=make_mesh_2d(4, 2))
+        assert run2d is not None
+        ests = np.asarray(run2d(jnp.arange(16)))
+        se = ests.std(ddof=1) / 4
+        assert abs(ests.mean() - true_gaussian_auc(1.0)) < 5 * se + 1e-3
 
 
 class TestWorkersSweep:

@@ -10,7 +10,10 @@ import pytest
 
 from tuplewise_tpu import Estimator
 from tuplewise_tpu.data import make_gaussians
-from tuplewise_tpu.parallel.partition import draw_pair_design
+from tuplewise_tpu.parallel.partition import (
+    draw_pair_design,
+    draw_triplet_design,
+)
 
 
 class TestDrawPairDesign:
@@ -50,6 +53,55 @@ class TestDrawPairDesign:
     def test_unknown_design(self):
         with pytest.raises(ValueError, match="unknown sampling design"):
             draw_pair_design(np.random.default_rng(0), 5, 5, 3, "systematic")
+
+
+class TestDrawTripletDesign:
+    def test_swor_distinct_and_off_diagonal(self):
+        rng = np.random.default_rng(0)
+        i, j, k = draw_triplet_design(rng, 12, 9, 800, "swor")
+        assert len(set(zip(i.tolist(), j.tolist(), k.tolist()))) == 800
+        assert np.all(i != j)
+        assert i.max() < 12 and j.max() < 12 and k.max() < 9
+
+    def test_swor_covers_full_grid(self):
+        """Drawing the WHOLE grid enumerates every valid triple exactly
+        once — the linearization is a bijection."""
+        rng = np.random.default_rng(1)
+        n1, n2 = 5, 3
+        grid = n1 * (n1 - 1) * n2
+        i, j, k = draw_triplet_design(rng, n1, n2, grid, "swor")
+        assert len(set(zip(i.tolist(), j.tolist(), k.tolist()))) == grid
+        assert np.all(i != j)
+
+    def test_swr_matches_legacy_call_sequence(self):
+        """swr reproduces the rng call order the NumPy backend always
+        used (i, shifted j, k) — committed config-4 results depend on
+        seed stability."""
+        rng1 = np.random.default_rng(5)
+        i1 = rng1.integers(0, 20, size=100)
+        j1 = rng1.integers(0, 19, size=100)
+        j1 = np.where(j1 >= i1, j1 + 1, j1)
+        k1 = rng1.integers(0, 7, size=100)
+        i2, j2, k2 = draw_triplet_design(
+            np.random.default_rng(5), 20, 7, 100, "swr"
+        )
+        assert np.array_equal(i1, i2)
+        assert np.array_equal(j1, j2)
+        assert np.array_equal(k1, k2)
+
+    def test_bernoulli_realized_size_binomial(self):
+        rng = np.random.default_rng(3)
+        sizes = [
+            len(draw_triplet_design(rng, 10, 10, 300, "bernoulli")[0])
+            for _ in range(50)
+        ]
+        # Binomial(900, 1/3): mean 300, sd ~14
+        assert 250 < np.mean(sizes) < 350
+        assert np.std(sizes) > 1.0
+
+    def test_tiny_n1_raises(self):
+        with pytest.raises(ValueError, match="n1"):
+            draw_triplet_design(np.random.default_rng(0), 1, 5, 3, "swor")
 
 
 @pytest.fixture(scope="module")
@@ -130,17 +182,39 @@ class TestEstimatorDesigns:
             A, n_pairs=3000, seed=5, design="swor")
         assert abs(got - want) / max(abs(want), 1) < 1e-5
 
-    def test_mesh_triplet_rejects_non_swr(self):
+    @pytest.mark.parametrize("design", ["swor", "bernoulli"])
+    def test_triplet_designs_all_backends_match(self, design):
+        """The three-design matrix is complete for degree 3 [VERDICT r2
+        next #4]: numpy / jax / mesh share the host sampler, so the
+        same seed yields the same tuple set and matching estimates."""
         import jax
 
-        if jax.device_count() < 8:
-            pytest.skip("needs 8 virtual devices")
         rng = np.random.default_rng(9)
         X = rng.standard_normal((48, 3))
         Y = rng.standard_normal((40, 3))
-        est = Estimator("triplet_indicator", backend="mesh", n_workers=8)
-        with pytest.raises(ValueError, match="swr"):
-            est.incomplete(X, Y, n_pairs=100, design="swor")
+        want = Estimator("triplet_indicator", backend="numpy").incomplete(
+            X, Y, n_pairs=900, seed=4, design=design)
+        got_jax = Estimator("triplet_indicator", backend="jax").incomplete(
+            X, Y, n_pairs=900, seed=4, design=design)
+        assert abs(got_jax - want) < 1e-6, design
+        if jax.device_count() >= 8:
+            got_mesh = Estimator(
+                "triplet_indicator", backend="mesh", n_workers=8,
+            ).incomplete(X, Y, n_pairs=900, seed=4, design=design)
+            assert abs(got_mesh - want) < 1e-6, design
+
+    def test_triplet_swor_unbiased(self):
+        """SWOR triplet sampling stays unbiased for the complete
+        degree-3 statistic."""
+        rng = np.random.default_rng(11)
+        X = rng.standard_normal((30, 3))
+        Y = rng.standard_normal((24, 3))
+        est = Estimator("triplet_hinge", backend="numpy")
+        u_n = est.complete(X, Y)
+        vals = [est.incomplete(X, Y, n_pairs=2000, seed=m, design="swor")
+                for m in range(40)]
+        se = np.std(vals) / np.sqrt(len(vals)) + 1e-6
+        assert abs(np.mean(vals) - u_n) < 5 * se
 
     def test_cpp_backend_inherits_designs(self, scores):
         from tuplewise_tpu.native import load_pair_lib

@@ -200,9 +200,15 @@ class JaxBackend:
                 k.pair_elementwise(A[i], B[j], jnp), dtype=A.dtype
             )
 
+        def gather_triplet_mean_fn(A, B, i, j, kk):
+            return jnp.mean(
+                k.triplet_values(A[i], A[j], B[kk], jnp), dtype=A.dtype
+            )
+
         # host-designed samples (swor/bernoulli): indices come from the
         # shared NumPy sampler, only the kernel evaluation is on device
         self._gather_mean = jax.jit(gather_mean_fn)
+        self._gather_triplet_mean = jax.jit(gather_triplet_mean_fn)
 
     # ------------------------------------------------------------------ #
     def _dev(self, A, B):
@@ -251,10 +257,17 @@ class JaxBackend:
         A, B = self._dev(A, B)
         if design != "swr":
             if self.kernel.kind == "triplet":
-                raise ValueError(
-                    "triplet incomplete sampling supports design='swr' "
-                    f"only, got {design!r}"
+                from tuplewise_tpu.parallel.partition import (
+                    draw_triplet_design,
                 )
+
+                i, j, kk = draw_triplet_design(
+                    np.random.default_rng(seed), A.shape[0], B.shape[0],
+                    n_pairs, design,
+                )
+                return float(self._gather_triplet_mean(
+                    A, B, jnp.asarray(i), jnp.asarray(j),
+                    jnp.asarray(kk)))
             from tuplewise_tpu.parallel.partition import draw_pair_design
 
             one_sample = not self.kernel.two_sample

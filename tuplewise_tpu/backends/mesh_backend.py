@@ -303,6 +303,30 @@ class MeshBackend:
 
         self._designed = jax.jit(designed_fn)
 
+        # ---- designed incomplete, degree 3 [VERDICT r2 next #4] ------- #
+        def designed_triplet_body(av, pv, bv, w):
+            vals = k.triplet_values(av[0], pv[0], bv[0], jnp)
+            s = lax.psum(jnp.sum(vals * w[0], dtype=vals.dtype), axes)
+            c = lax.psum(jnp.sum(w[0], dtype=vals.dtype), axes)
+            return s / c
+
+        def designed_triplet_fn(Ag, Bg, i, j, kk, w):
+            """Anchor/positive rows gather from the first sample, the
+            negative from the second — three cross-shard gathers (the
+            priced communication), then local evaluation."""
+            Ai = Ag.at[i].get(out_sharding=shard2)
+            Aj = Ag.at[j].get(out_sharding=shard2)
+            Bk = Bg.at[kk].get(out_sharding=shard2)
+            return jax.shard_map(
+                designed_triplet_body,
+                mesh=self.mesh,
+                in_specs=(PA, PA, PA, PA),
+                out_specs=P(),
+                check_vma=False,
+            )(Ai, Aj, Bk, w)
+
+        self._designed_triplet = jax.jit(designed_triplet_fn)
+
     # ------------------------------------------------------------------ #
     # packing helpers (host side)                                        #
     # ------------------------------------------------------------------ #
@@ -390,7 +414,8 @@ class MeshBackend:
         rounded UP to a multiple of N (never under-samples B).
 
         design="swor"/"bernoulli" use the shared host sampler
-        (parallel.partition.draw_pair_design) to draw the DISTINCT
+        (parallel.partition.draw_pair_design / draw_triplet_design —
+        degree 2 and 3 alike) to draw the DISTINCT
         global tuple set — identical indices to the numpy/jax backends
         at the same seed — then shard the tuple list over workers and
         regather each worker's sampled rows across shards (the priced
@@ -409,10 +434,18 @@ class MeshBackend:
             return float(self._incomplete(
                 key, a, ma, ia, b, mb, ib, n_pairs=n_pairs))
         if self.kernel.kind == "triplet":
-            raise ValueError(
-                "triplet incomplete sampling supports design='swr' only, "
-                f"got {design!r}"
+            from tuplewise_tpu.parallel.partition import (
+                draw_triplet_design,
             )
+
+            A, Bv = np.asarray(A), np.asarray(B)
+            i, j, kk = draw_triplet_design(
+                np.random.default_rng(seed), len(A), len(Bv), n_pairs,
+                design,
+            )
+            ii, jj, kki, w = self._pack_design((i, j, kk))
+            return float(self._designed_triplet(
+                self._global(A), self._global(Bv), ii, jj, kki, w))
         from tuplewise_tpu.parallel.partition import draw_pair_design
 
         A = np.asarray(A)
@@ -424,24 +457,31 @@ class MeshBackend:
             np.random.default_rng(seed), n1, n2, n_pairs, design,
             one_sample=one_sample,
         )
-        N = self.n_shards
-        size = len(i)
-        per = -(-size // N)
-        pad = N * per - size
-        w = np.concatenate([np.ones(size), np.zeros(pad)])
-        i = np.concatenate([i, np.zeros(pad, i.dtype)])
-        j = np.concatenate([j, np.zeros(pad, j.dtype)])
+        ii, jj, w = self._pack_design((i, j))
         Ag = self._global(A)
         Bg = Ag if Bv is A else self._global(Bv)
+        return float(self._designed(Ag, Bg, ii, jj, w))
+
+    def _pack_design(self, idx_arrays):
+        """Pad a host-designed tuple list to a multiple of N, shard the
+        [N, per] index blocks over workers, and append the {0,1} weight
+        mask pricing the realized tuple count (bernoulli draws vary)."""
+        N = self.n_shards
+        size = len(idx_arrays[0])
+        per = -(-size // N)
+        pad = N * per - size
         put = functools.partial(
             jax.device_put, device=self._block_sharding
         )
-        return float(self._designed(
-            Ag, Bg,
-            put(jnp.asarray(i.reshape(N, per), jnp.int32)),
-            put(jnp.asarray(j.reshape(N, per), jnp.int32)),
-            put(jnp.asarray(w.reshape(N, per), self.dtype)),
-        ))
+        out = [
+            put(jnp.asarray(
+                np.concatenate([a, np.zeros(pad, a.dtype)])
+                .reshape(N, per), jnp.int32))
+            for a in idx_arrays
+        ]
+        w = np.concatenate([np.ones(size), np.zeros(pad)])
+        out.append(put(jnp.asarray(w.reshape(N, per), self.dtype)))
+        return out
 
     # ------------------------------------------------------------------ #
     def _two(self, A, B):

@@ -23,6 +23,7 @@ from tuplewise_tpu.backends.base import register_backend
 from tuplewise_tpu.ops.kernels import Kernel, get_kernel
 from tuplewise_tpu.parallel.partition import (
     draw_pair_design,
+    draw_triplet_design,
     partition_indices,
     partition_two_sample,
 )
@@ -208,17 +209,11 @@ class NumpyBackend:
         k = self.kernel
         rng = np.random.default_rng(seed)
         if k.kind == "triplet":
-            if design != "swr":
-                raise ValueError(
-                    "triplet incomplete sampling supports design='swr' "
-                    f"only, got {design!r}"
-                )
-            n1, n2 = len(A), len(B)
-            i = rng.integers(0, n1, size=n_pairs)
-            # j must differ from i: draw from n1-1 and shift past i.
-            j = rng.integers(0, n1 - 1, size=n_pairs)
-            j = np.where(j >= i, j + 1, j)
-            kk = rng.integers(0, n2, size=n_pairs)
+            # all three designs via the shared degree-3 sampler; swr
+            # reproduces the historical i / shifted-j / k call sequence
+            i, j, kk = draw_triplet_design(
+                rng, len(A), len(B), n_pairs, design
+            )
             vals = k.triplet_values(A[i], A[j], B[kk], np)
             return float(np.mean(vals))
         one_sample = not k.two_sample

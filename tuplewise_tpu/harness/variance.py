@@ -57,7 +57,13 @@ def _estimate_once(est: Estimator, cfg: VarianceConfig, rep: int) -> float:
         cfg.n_pos, cfg.n_neg, cfg.dim, cfg.separation,
         seed=cfg.seed * 1_000_003 + rep,
     )
-    s1, s2 = X[:, 0], Y[:, 0]
+    kern = get_kernel(cfg.kernel)
+    if kern.kind == "diff":
+        s1, s2 = X[:, 0], Y[:, 0]      # score-difference kernels: scalars
+    else:
+        s1, s2 = X, Y                  # feature kernels need [n, d] rows
+    if not kern.two_sample:
+        s2 = None                      # one-sample: the API takes A only
     if cfg.scheme == "complete":
         return est.complete(s1, s2)
     if cfg.scheme == "local":
@@ -255,12 +261,14 @@ def run_variance_experiment(
                 _estimate_once(est, cfg, r) for r in range(m, m + chunk)
             ])
 
-    from tuplewise_tpu.utils.profiling import timer, trace
+    from tuplewise_tpu.utils.profiling import annotate, timer, trace
 
     with trace(trace_dir):  # jax.profiler scope when requested [§5.2]
         for m, chunk in iter_chunks(start, cfg.n_reps, checkpoint_every):
             timed = run_chunk(m, chunk)  # warm-up outside the window
-            with timer() as t:
+            # named span per chunk so the trace digest attributes time
+            # to rep ranges, not one undifferentiated blob [§5.2]
+            with timer() as t, annotate(f"mc_reps[{m}:{m + chunk}]"):
                 est_parts.append(timed())
             wallclock += t["seconds"]
             if checkpoint_path:

@@ -1,0 +1,194 @@
+"""Simulated-N distributed pairwise SGD — the learning-side trade-off
+instrument [SURVEY §1.3, §4.4; VERDICT r2 next #1].
+
+The paper's learning experiments sweep worker counts far beyond any
+physical device count (the trade-off becomes visible when per-worker
+blocks are SMALL, i.e. N large). This module runs the SAME distributed
+semantics as models.pairwise_sgd's mesh trainer — identical partition
+fold chains, identical draw_blocks, identical per-step schedule — but
+maps workers onto a `jax.vmap` axis on ONE chip instead of a device
+mesh, so N is limited by memory, not hardware. A second vmap axis runs
+Monte-Carlo seeds in the same compiled program: learning curves arrive
+averaged, with error bars, in one scan.
+
+Equivalence to the mesh trainer is a TESTED property, not an intent:
+with the same TrainConfig and seed, the simulated trainer reproduces
+the mesh trainer's parameter trajectory to float tolerance
+(tests/test_sim_learner.py) — the key chains match because both fold
+(root, "repartition", t) / (root, "step", t) / (kt, "pair_sample", w)
+through utils.rng.fold and share parallel.device_partition.draw_blocks.
+
+Scope: full-local-pair or sampled-pair losses on diff kernels, direct
+[m1, m2] per-worker pair grids (memory N * m1 * m2 per seed — the
+small-block regime this instrument exists for; production-scale blocks
+belong to the mesh trainer's streamed tiles).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tuplewise_tpu.ops import pair_tiles
+from tuplewise_tpu.ops.kernels import get_kernel
+from tuplewise_tpu.ops.rank_auc import rank_auc
+from tuplewise_tpu.parallel.device_partition import draw_blocks
+from tuplewise_tpu.utils.rng import fold, root_key
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_sim_trainer(scorer, cfg, n1, n2):
+    """Jitted chunk program vmapped over (seeds, workers).
+
+    Signature: run(params_batch, Xp, Xn, roots, t0, chunk_len) ->
+    (params_batch, losses [S, chunk]); params_batch has a leading seed
+    axis, roots is a [S] key array. Cache key excludes steps/seed (both
+    are runtime inputs), mirroring pairwise_sgd._compiled_trainer."""
+    kernel = get_kernel(cfg.kernel)
+    N = cfg.n_workers
+    m1, m2 = n1 // N, n2 // N
+
+    def local_loss(p, a, b, kk):
+        """One worker's loss on its [m1, d] / [m2, d] blocks."""
+        s1 = scorer.apply(p, a, jnp)
+        s2 = scorer.apply(p, b, jnp)
+        if cfg.pairs_per_worker is None:
+            d = s1[:, None] - s2[None, :]
+            return jnp.mean(kernel.diff(d, jnp))
+        i, j = pair_tiles.sample_pair_indices(
+            kk, m1, m2, cfg.pairs_per_worker, one_sample=False
+        )
+        return jnp.mean(kernel.diff(s1[i] - s2[j], jnp))
+
+    def draw_both(kr):
+        k1, k2 = jax.random.split(kr)
+        return (
+            draw_blocks(k1, n1, N, cfg.scheme, m=m1),
+            draw_blocks(k2, n2, N, cfg.scheme, m=m2),
+        )
+
+    def step(carry, t, t0, Xp, Xn, root):
+        params, Ab, Bb = carry
+
+        def refresh(_):
+            i1, i2 = draw_both(fold(root, "repartition", t))
+            return Xp[i1], Xn[i2]
+
+        # first blocks (incl. a boundary-aligned t0) come from chunk_fn
+        # with the same key — refresh only on LATER boundaries, exactly
+        # as the mesh trainer does
+        Ab, Bb = lax.cond(
+            (t % cfg.repartition_every == 0) & (t > t0),
+            refresh, lambda _: (Ab, Bb), None,
+        )
+        kt = fold(root, "step", t)
+        keys = jax.vmap(lambda w: fold(kt, "pair_sample", w))(
+            jnp.arange(N)
+        )
+        losses, grads = jax.vmap(
+            jax.value_and_grad(local_loss), in_axes=(None, 0, 0, 0)
+        )(params, Ab, Bb, keys)
+        g = jax.tree.map(lambda x: jnp.mean(x, axis=0), grads)
+        params = jax.tree.map(lambda p, gg: p - cfg.lr * gg, params, g)
+        return (params, Ab, Bb), jnp.mean(losses)
+
+    def chunk_one_seed(params, Xp, Xn, root, t0, chunk_len):
+        # regather blocks as of the latest repartition boundary, with
+        # the key folded from that boundary's absolute index: chunked
+        # runs reproduce the unchunked trajectory bit-for-bit
+        r0 = t0 - t0 % cfg.repartition_every
+        i1, i2 = draw_both(fold(root, "repartition", r0))
+        (params, _, _), losses = lax.scan(
+            functools.partial(step, t0=t0, Xp=Xp, Xn=Xn, root=root),
+            (params, Xp[i1], Xn[i2]),
+            t0 + jnp.arange(chunk_len),
+        )
+        return params, losses
+
+    run = jax.vmap(chunk_one_seed, in_axes=(0, None, None, 0, None, None))
+    return jax.jit(run, static_argnums=5)
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_auc_eval(scorer):
+    @jax.jit
+    def ev(params_batch, Xp_te, Xn_te):
+        def one(p):
+            return rank_auc(
+                scorer.apply(p, Xp_te, jnp), scorer.apply(p, Xn_te, jnp)
+            )
+
+        return jax.vmap(one)(params_batch)
+
+    return ev
+
+
+def train_curves(
+    scorer,
+    params0,
+    X_pos: np.ndarray,
+    X_neg: np.ndarray,
+    X_pos_test: np.ndarray,
+    X_neg_test: np.ndarray,
+    cfg,
+    *,
+    n_seeds: int = 8,
+    eval_every: int = 25,
+):
+    """Monte-Carlo learning curves of simulated-N distributed SGD.
+
+    Trains ``n_seeds`` independent replicas (seeds cfg.seed ..
+    cfg.seed + n_seeds - 1 govern partition/sampling randomness; the
+    init is SHARED so the spread isolates the partition effect),
+    evaluating held-out rank AUC every ``eval_every`` steps.
+
+    Returns a dict: ``steps`` [K], ``test_auc`` [S, K] (K includes the
+    step-0 init point), ``loss`` [S, steps], ``final_params`` pytree
+    with leading seed axis.
+    """
+    n1, n2 = len(X_pos), len(X_neg)
+    N = cfg.n_workers
+    if n1 // N < 1 or n2 // N < 1:
+        raise ValueError(f"n=({n1},{n2}) too small for {N} workers")
+    run = _compiled_sim_trainer(
+        scorer, dataclasses.replace(cfg, steps=0, seed=0), n1, n2
+    )
+    ev = _compiled_auc_eval(scorer)
+
+    S = n_seeds
+    params = jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            jnp.asarray(x, jnp.float32), (S,) + np.shape(x)
+        ),
+        params0,
+    )
+    roots = jax.vmap(root_key)(cfg.seed + jnp.arange(S))
+    Xp = jnp.asarray(X_pos, jnp.float32)
+    Xn = jnp.asarray(X_neg, jnp.float32)
+    Xp_te = jnp.asarray(X_pos_test, jnp.float32)
+    Xn_te = jnp.asarray(X_neg_test, jnp.float32)
+
+    steps_axis = [0]
+    aucs = [np.asarray(ev(params, Xp_te, Xn_te))]
+    loss_parts = []
+    t = 0
+    while t < cfg.steps:
+        chunk = min(eval_every, cfg.steps - t)
+        params, losses = run(
+            params, Xp, Xn, roots, jnp.asarray(t, jnp.int32), chunk
+        )
+        loss_parts.append(np.asarray(losses))
+        t += chunk
+        steps_axis.append(t)
+        aucs.append(np.asarray(ev(params, Xp_te, Xn_te)))
+    return {
+        "steps": np.asarray(steps_axis),
+        "test_auc": np.stack(aucs, axis=1),        # [S, K]
+        "loss": np.concatenate(loss_parts, axis=1),  # [S, steps]
+        "final_params": params,
+    }

@@ -39,7 +39,13 @@ from jax.experimental.pallas import tpu as pltpu
 from tuplewise_tpu.ops.kernels import Kernel
 
 
-MAX_ROW_BLOCKS = 1536  # [g1, 2] SMEM accumulator budget (~1 MiB / 512 B)
+# SMEM budget for the [g1, 2] accumulator: each f32 cell pads to a
+# 512-byte SMEM word, so 1 MiB holds 2048 cells = 1024 row blocks of
+# 2 cells each; 1536 row blocks (3072 cells) was measured as the
+# largest allocation Mosaic accepts on v5e (some SMEM is reserved by
+# the runtime), kept as the hard cap with the safety margin already in
+# the measurement.
+MAX_ROW_BLOCKS = 1536
 
 
 def resolve_pallas_mode(platform: str):

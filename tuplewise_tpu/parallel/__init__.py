@@ -12,14 +12,19 @@ from tuplewise_tpu.parallel.faults import (
 )
 from tuplewise_tpu.parallel.partition import (
     draw_pair_design,
+    draw_triplet_design,
     partition_indices,
     partition_two_sample,
 )
+
+# tuplewise_tpu.parallel.distributed (multi-process launch) is likewise
+# not imported here: it is jax-adjacent and must run BEFORE jax init.
 
 __all__ = [
     "alive_mask",
     "detect_dropped_workers",
     "draw_pair_design",
+    "draw_triplet_design",
     "normalize_dropped",
     "run_with_fault_tolerance",
     "partition_indices",

@@ -1,0 +1,99 @@
+"""Multi-process launch: make the dcn mesh axis launchable, not just
+modeled [SURVEY §5.8; VERDICT r2 next #8].
+
+The 2-D (dcn x ici) ring primitives and the hierarchical mesh layout
+are validated in-process on virtual devices; this module supplies the
+missing entry point for REAL multi-host runs:
+
+* :func:`initialize` wraps ``jax.distributed.initialize`` behind
+  explicit arguments or ``TUPLEWISE_DIST_*`` environment flags, so a
+  launcher (mpirun / k8s indexed jobs / manual shells) can bring up the
+  process group without code changes;
+* :func:`global_mesh` builds the mesh from the PROCESS topology after
+  initialization: the leading ("dcn") axis enumerates processes, the
+  trailing ("w") axis the devices within each process — exactly the
+  layout ring_pair_stats_2d keeps block rotation on ICI for.
+
+On a single process both degrade gracefully: ``initialize`` is a no-op
+without flags, and ``global_mesh`` returns the local 1-D or 2-D mesh.
+A real 2-process CPU smoke test lives in tests/test_distributed.py
+(subprocesses coordinate over localhost; the complete-U ring value must
+match the single-process oracle bit-for-bit in f32).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_ENV_PREFIX = "TUPLEWISE_DIST_"
+
+
+def dist_env() -> dict:
+    """The TUPLEWISE_DIST_* launch flags present in the environment:
+    COORDINATOR (host:port), NUM_PROCESSES, PROCESS_ID."""
+    out = {}
+    for key, cast in (("COORDINATOR", str), ("NUM_PROCESSES", int),
+                      ("PROCESS_ID", int)):
+        val = os.environ.get(_ENV_PREFIX + key)
+        if val is not None:
+            out[key.lower()] = cast(val)
+    return out
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring up the JAX process group; returns True when distributed
+    mode is active.
+
+    Explicit arguments win; otherwise the TUPLEWISE_DIST_* environment
+    flags apply; with neither, this is a no-op (single-process mode) —
+    the flag-gating of VERDICT r2 next #8. Must run before any jax
+    computation, like jax.distributed.initialize itself.
+    """
+    env = dist_env()
+    coordinator_address = coordinator_address or env.get("coordinator")
+    if num_processes is None:
+        num_processes = env.get("num_processes")
+    if process_id is None:
+        process_id = env.get("process_id")
+    if (coordinator_address is None and num_processes is None
+            and process_id is None):
+        return False   # nothing set anywhere: single-process mode
+    if not (coordinator_address and num_processes is not None
+            and process_id is not None):
+        raise ValueError(
+            "distributed launch needs coordinator_address, num_processes "
+            f"AND process_id (got {coordinator_address!r}, "
+            f"{num_processes!r}, {process_id!r}); set all three "
+            f"{_ENV_PREFIX}* flags or pass them explicitly"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes),
+        process_id=int(process_id),
+    )
+    return True
+
+
+def global_mesh():
+    """Device mesh from the process topology.
+
+    Multi-process: a 2-D (dcn, w) mesh with one dcn row per process —
+    jax.devices() orders devices by process index, so consecutive
+    groups of ``local_device_count`` share a process and the trailing
+    axis stays intra-host (ICI). Single-process: the local 1-D mesh
+    (or 2-D when the caller wants one, via make_mesh_2d directly).
+    """
+    import jax
+
+    from tuplewise_tpu.parallel.mesh import make_mesh, make_mesh_2d
+
+    if jax.process_count() == 1:
+        return make_mesh()
+    return make_mesh_2d(jax.process_count(), jax.local_device_count())
