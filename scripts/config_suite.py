@@ -36,7 +36,12 @@ def log(msg):
     print(f"[configs] {msg}", file=sys.stderr, flush=True)
 
 
+QUICK = False   # set by main(); stamped so quick rows can't pass as full
+
+
 def emit(rec, out):
+    if QUICK:
+        rec["quick"] = True
     rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     out.write(json.dumps(rec) + "\n")
     out.flush()
@@ -102,6 +107,9 @@ def config2(out, q):
     cfg = TrainConfig(kernel="hinge", lr=0.3, steps=steps,
                       n_workers=min(4, jax.device_count()),
                       repartition_every=10, seed=0)
+    # warm with the SAME step count (chunk length is a static jit arg;
+    # a different warm length would leave a recompile in the window)
+    train_pairwise(scorer, p0, Xp, Xn, cfg)
     t0 = time.perf_counter()
     params, hist = train_pairwise(scorer, p0, Xp, Xn, cfg)
     dt = time.perf_counter() - t0
@@ -289,12 +297,27 @@ def main():
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--configs", default="1,2,2b,3,4,5")
     args = ap.parse_args()
+    global QUICK
+    QUICK = args.quick
     os.makedirs(RESULTS, exist_ok=True)
     path = os.path.join(RESULTS, "configs.jsonl")
     wanted = set(args.configs.split(","))
     fns = {"1": config1, "2": config2, "2b": config2b, "3": config3,
            "4": config4, "5": config5}
+    # a subset run replaces only ITS rows — truncating the whole file
+    # here once silently destroyed the other configs' committed rows
+    keep = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if str(rec.get("config")) not in wanted:
+                    keep.append(line)
     with open(path, "w") as out:
+        out.writelines(keep)
         for key in sorted(wanted):
             try:
                 fns[key](out, args.quick)

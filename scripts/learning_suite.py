@@ -28,9 +28,12 @@ invocations):
       # sim sweeps + 8-virtual-CPU mesh rows (forces the CPU platform)
   python scripts/learning_suite.py --stages chip
       # mesh-of-1 training throughput on the attached TPU chip
+  python scripts/learning_suite.py --stages trace
+      # profiler digest of a training run (repartition-event cost)
 
 Outputs: results/learning_gauss.jsonl, results/learning_adult.jsonl,
-results/learning_throughput.jsonl, results/figures/learning_*.png.
+results/learning_throughput{,_chip}.jsonl,
+results/trace_train_chip_summary.txt, results/figures/learning_*.png.
 """
 
 from __future__ import annotations
@@ -51,6 +54,7 @@ FIGS = os.path.join(RESULTS, "figures")
 
 T0 = time.perf_counter()
 NEVER = 1 << 30   # repartition_every sentinel for "never" (n_r = null)
+QUICK = False     # set by main(); quick output NEVER touches full files
 
 
 def log(msg):
@@ -61,15 +65,44 @@ def log(msg):
 _touched = set()
 
 
+def _quick_name(name: str) -> str:
+    """The one copy of the quick-suffix rule: quick runs write to
+    *_quick siblings (JSONL and figures alike) so a smoke test can
+    never truncate/replace committed full-run artifacts."""
+    if QUICK:
+        stem, ext = os.path.splitext(name)
+        name = f"{stem}_quick{ext}"
+    return name
+
+
+def _out_path(name: str) -> str:
+    return os.path.join(RESULTS, _quick_name(name))
+
+
 def emit(rec, out_name):
-    path = os.path.join(RESULTS, out_name)
-    if path not in _touched:     # truncate once per invocation
+    """Rows accumulate in a .partial sibling; finalize_outputs() renames
+    onto the real file only when the invocation completes — a crash or
+    Ctrl-C mid-stage leaves the committed artifact untouched (the
+    hazard config_suite's keep-other-rows merge guards against)."""
+    path = _out_path(out_name)
+    partial = path + ".partial"
+    if path not in _touched:
         _touched.add(path)
-        if os.path.exists(path):
-            os.remove(path)
+        if os.path.exists(partial):
+            os.remove(partial)
+    if QUICK:
+        rec["quick"] = True
     rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    with open(path, "a") as f:
+    with open(partial, "a") as f:
         f.write(json.dumps(rec) + "\n")
+
+
+def finalize_outputs():
+    for path in sorted(_touched):
+        partial = path + ".partial"
+        if os.path.exists(partial):
+            os.replace(partial, path)
+            log(f"finalized {os.path.basename(path)}")
 
 
 def run_config(scorer, p0, data, cfg, *, n_seeds, eval_every, dataset,
@@ -208,11 +241,13 @@ def _throughput_row(n_per_class, cfg, label, platform, steps_timed=30,
     )
     scorer = LinearScorer(dim=5)
     p0 = scorer.init(0)
-    # warm run compiles the chunk; timed run reuses it (the compiled-
-    # chunk cache keys on cfg-sans-steps + mesh + sizes)
-    warm = dataclasses.replace(cfg, steps=2)
-    train_pairwise(scorer, p0, Xp, Xn, warm)
+    # warm with the SAME step count: the chunk length is a STATIC jit
+    # argument, so a shorter warm run compiles a different executable
+    # and the timed run would recompile inside the window (this bug
+    # once inflated these rows ~10x at n=1e5 — caught by the committed
+    # trace digest showing 1.2 s of device time in a 22 s wall)
     timed = dataclasses.replace(cfg, steps=steps_timed)
+    train_pairwise(scorer, p0, Xp, Xn, timed)
     t0 = time.perf_counter()
     params, hist = train_pairwise(scorer, p0, Xp, Xn, timed)
     wc = time.perf_counter() - t0
@@ -277,6 +312,46 @@ def stage_chip(q, platform):
             )
 
 
+def stage_trace(q, platform):
+    """Profiler evidence for the trainer [VERDICT r2 next #7]: a warm
+    20-step run with n_r=2 under jax.profiler, digested to text by
+    scripts/trace_summary.py (results/trace_train_chip_summary.txt).
+    The repartition events appear as conditional/dynamic-slice/gather
+    rows against the step scan's while loop."""
+    import subprocess
+
+    import jax
+
+    from tuplewise_tpu.data import make_gaussian_splits
+    from tuplewise_tpu.models.pairwise_sgd import TrainConfig, train_pairwise
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    n = 2048 if q else 100_000
+    Xp, Xn, _, _ = make_gaussian_splits(n, 1000, dim=5, seed=0)
+    scorer = LinearScorer(dim=5)
+    p0 = scorer.init(0)
+    cfg = TrainConfig(kernel="hinge", lr=0.3, steps=20, n_workers=1,
+                      repartition_every=2, seed=7, tile=2048)
+    train_pairwise(scorer, p0, Xp, Xn, cfg)   # warm SAME chunk length
+    trace_dir = _out_path("trace_train_chip")
+    import shutil
+
+    shutil.rmtree(trace_dir, ignore_errors=True)  # one run per digest
+    with jax.profiler.trace(trace_dir):
+        t0 = time.perf_counter()
+        train_pairwise(scorer, p0, Xp, Xn, cfg)
+        log(f"traced 20 steps n_r=2 in {time.perf_counter() - t0:.2f}s")
+    digest = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_summary.py"),
+         trace_dir, "14"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    out = _out_path("trace_train_chip_summary.txt")
+    with open(out, "w") as f:
+        f.write(digest)
+    log(f"wrote {out}")
+
+
 def stage_figs():
     from tuplewise_tpu.harness.figures import (
         plot_auc_vs_budget, plot_auc_vs_comm, plot_learning_curves,
@@ -285,11 +360,14 @@ def stage_figs():
     os.makedirs(FIGS, exist_ok=True)
 
     def load(name):
-        p = os.path.join(RESULTS, name)
+        p = _out_path(name)
         if not os.path.exists(p):
             return []
         with open(p) as f:
             return [json.loads(x) for x in f if x.strip()]
+
+    def fig_path(name):
+        return os.path.join(FIGS, _quick_name(name))
 
     for dataset, fname in (("gaussians", "learning_gauss.jsonl"),
                            ("adult", "learning_adult.jsonl")):
@@ -300,13 +378,13 @@ def stage_figs():
             sub = [r for r in rows if r["n_workers"] == N]
             plot_learning_curves(
                 sub,
-                os.path.join(FIGS, f"learning_curves_{dataset}_N{N}.png"),
+                fig_path(f"learning_curves_{dataset}_N{N}.png"),
                 title=f"{dataset}, N={N} workers "
                       f"(m={sub[0]['m_per_worker'][0]}/class)",
             )
         plot_auc_vs_comm(
             rows,
-            os.path.join(FIGS, f"learning_auc_vs_comm_{dataset}.png"),
+            fig_path(f"learning_auc_vs_comm_{dataset}.png"),
             title=f"{dataset}: final held-out AUC vs communication",
         )
     # pair-budget sweep figure: B rows + the matching all-pairs rows
@@ -319,7 +397,7 @@ def stage_figs():
                 and r["n_workers"] == N and r["n_r"] in nrs]
         plot_auc_vs_budget(
             b_rows + full,
-            os.path.join(FIGS, "learning_auc_vs_budget.png"),
+            fig_path("learning_auc_vs_budget.png"),
             title=f"gaussians, N={N}: pair budget x repartition",
         )
     log(f"figures written to {FIGS}")
@@ -329,15 +407,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--stages", default="gauss,adult,mesh8,figs",
-                    help="comma list: gauss,adult,mesh8,chip,figs")
+                    help="comma list: gauss,adult,mesh8,chip,trace,figs")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
-    known = {"gauss", "adult", "mesh8", "chip", "figs"}
+    known = {"gauss", "adult", "mesh8", "chip", "trace", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}")
-    if "chip" in stages and stages & {"gauss", "adult", "mesh8"}:
+    if stages & {"chip", "trace"} and stages & {"gauss", "adult", "mesh8"}:
         ap.error("run --stages chip in its own invocation: the platform "
                  "(TPU vs forced-CPU) is process-global")
+    global QUICK
+    QUICK = args.quick
     os.makedirs(RESULTS, exist_ok=True)
 
     if stages & {"gauss", "adult", "mesh8"}:
@@ -366,6 +446,11 @@ def main():
         stage_mesh8(args.quick, platform)
     if "chip" in stages:
         stage_chip(args.quick, platform)
+    if "trace" in stages:
+        stage_trace(args.quick, platform)
+    # data stages completed: atomically publish their rows BEFORE figs
+    # reads them (and so a crash above leaves committed files untouched)
+    finalize_outputs()
     if "figs" in stages:
         stage_figs()
     log("done")

@@ -71,10 +71,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--stages", type=str,
-                    default="1e6,1e7,tradeoff,mesh,figs",
+                    default="1e6,1e7,tradeoff,mesh,exact,figs",
                     help="comma list of stages to run (the default runs "
-                         "everything RESULTS.md commits, incl. the "
-                         "visible-trade-off regime)")
+                         "everything RESULTS.md commits: the production "
+                         "scales, the visible-trade-off regime, the mesh "
+                         "ring, and the exact rank-AUC series)")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
     known = {"1e6", "1e7", "tradeoff", "mesh", "exact", "figs"}
@@ -232,7 +233,10 @@ def main():
             runner = jax.jit(
                 lambda reps, f=one_rep: lax.map(f, reps)
             )
-            np.asarray(runner(jnp.arange(2)))     # compile outside timer
+            # warm at the SAME shape: the rep-array length is part of
+            # the jit signature, so a shorter warm run would leave a
+            # recompile inside the timed window
+            np.asarray(runner(jnp.arange(M)))
             t0 = time.perf_counter()
             ests = np.asarray(runner(jnp.arange(M)))
             wc = time.perf_counter() - t0

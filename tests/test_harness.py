@@ -339,6 +339,31 @@ class TestMeshMC:
         se = (r_dev["variance"] / 24 + np.var(host, ddof=1) / 24) ** 0.5
         assert abs(r_dev["mean"] - np.mean(host)) < 5 * se + 1e-3
 
+    @pytest.mark.parametrize(
+        "scheme", ["complete", "local", "repartitioned", "incomplete"]
+    )
+    def test_triplet_kernel_on_device(self, scheme):
+        """Degree-3 kernels run mesh-native too (double ring for
+        complete, global-id anchor/positive exclusion): the kernel-kind
+        matrix has no host-loop fallback left. Mean must match the
+        numpy complete statistic on the same distribution within MC
+        error."""
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            kernel="triplet_indicator", backend="mesh", scheme=scheme,
+            n_pos=64, n_neg=56, dim=3, n_workers=8, n_rounds=2,
+            n_pairs=4096, n_reps=24,
+        )
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"], "triplet mesh config fell back to host loop"
+        # population reference: numpy complete on a large fresh draw
+        from tuplewise_tpu.data import make_gaussians
+        from tuplewise_tpu.estimators.estimator import Estimator
+
+        X, Y = make_gaussians(400, 400, dim=3, separation=1.0, seed=99)
+        ref = Estimator("triplet_indicator", backend="numpy").complete(X, Y)
+        assert abs(r["mean"] - ref) < 5 * r["std_error"] + 0.02
+
     def test_2d_mesh_runner(self):
         """A 2-D (dcn x ici) mesh compiles and reproduces the 1-D
         runner's estimates distributionally [VERDICT r2 next #5]; the

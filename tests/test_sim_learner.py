@@ -8,7 +8,7 @@ import pytest
 
 from tuplewise_tpu.data import make_gaussian_splits
 from tuplewise_tpu.models.pairwise_sgd import TrainConfig, train_pairwise
-from tuplewise_tpu.models.scorers import LinearScorer
+from tuplewise_tpu.models.scorers import LinearScorer, MLPScorer
 from tuplewise_tpu.models.sim_learner import train_curves
 
 
@@ -48,6 +48,25 @@ class TestMeshParity:
                                    rtol=2e-4, atol=2e-5)
         np.testing.assert_allclose(out["loss"][0], mesh_hist["loss"],
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestMLPParity:
+    def test_mlp_matches_mesh_trainer(self, data):
+        """Scorer-genericity: the nonlinear MLP pytree takes the same
+        trajectory through both trainers."""
+        Xp, Xn, _, _ = data
+        scorer = MLPScorer(dim=5, hidden=8)
+        p0 = scorer.init(2)
+        cfg = TrainConfig(kernel="logistic", lr=0.3, steps=8,
+                          n_workers=8, repartition_every=4, seed=5)
+        mesh_params, _ = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        out = train_curves(scorer, p0, Xp, Xn, Xp[:64], Xn[:64], cfg,
+                           n_seeds=1, eval_every=100)
+        for k in p0:
+            np.testing.assert_allclose(
+                np.asarray(out["final_params"][k])[0], mesh_params[k],
+                rtol=2e-4, atol=2e-5, err_msg=k,
+            )
 
 
 class TestCurves:

@@ -239,11 +239,11 @@ class MeshBackend:
             (both packers place valid rows first and pad only the tail
             — we sample indices < valid_count)."""
             del ma, mb  # blocks come from pack_partition: no padding
-            # linearized shard id across all mesh axes
-            shard = lax.axis_index(axes[0])
-            for ax in axes[1:]:
-                shard = shard * lax.axis_size(ax) + lax.axis_index(ax)
-            kk = fold(key, "shard", shard)
+            from tuplewise_tpu.parallel.device_partition import (
+                linear_shard_index,
+            )
+
+            kk = fold(key, "shard", linear_shard_index(axes))
             per = -(-n_pairs // N)  # ceil: draw AT LEAST n_pairs total
             a0, b0 = a[0], b[0]
             na = a.shape[1]

@@ -18,11 +18,12 @@ the mesh:
 * reps run under `lax.map`, so M reps cost M compiled iterations with
   zero host round-trips in between.
 
-Coverage (r2 lifted the fallbacks): 1-D AND 2-D (dcn x ici) meshes,
+Coverage (r3 lifted every fallback): 1-D AND 2-D (dcn x ici) meshes,
 shard counts that do NOT divide n (tail shards carry masked padding;
-the ring runs mask-aware), and one-sample feature kernels (scatter)
-with global-id pair exclusion — alongside the original two-sample diff
-kernels. Triplet kernels and non-mesh backends still use the host loop.
+the ring runs mask-aware), one-sample feature kernels (scatter) with
+global-id pair exclusion, and degree-3 triplet kernels (double ring
+for complete; global-id anchor/positive exclusion) — every kernel kind
+runs mesh-native; only non-mesh backends use the host loop.
 
 Statistical contract: estimates are drawn from the SAME distribution as
 looping the public mesh Estimator with fresh data per rep (generation,
@@ -50,14 +51,15 @@ def _clamp_preferred(pref: int, base: int, m: int) -> int:
     return max(t, base)
 
 
-def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
+def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
+                        triplet_tile: int = 16):
     """Compiled rep-array -> estimate-array runner for mesh configs on
     Gaussian data, or None when this config can't run fully on device
-    (triplet kernels — the harness falls back to the host loop).
+    (only meshes of >2 axes; every kernel kind — diff, feature pair,
+    triplet — now runs mesh-native).
     """
     kernel = get_kernel(cfg.kernel)
-    if kernel.kind == "triplet":
-        return None
+    trip = kernel.kind == "triplet"
 
     import jax
     import jax.numpy as jnp
@@ -66,7 +68,9 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
 
     from tuplewise_tpu.ops import pair_tiles
     from tuplewise_tpu.parallel import ring
-    from tuplewise_tpu.parallel.device_partition import draw_blocks
+    from tuplewise_tpu.parallel.device_partition import (
+        draw_blocks, linear_shard_index,
+    )
     from tuplewise_tpu.parallel.mesh import make_mesh
     from tuplewise_tpu.utils.rng import fold, root_key
 
@@ -107,11 +111,6 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
         tile_a = _clamp_preferred(pa_, tile_a, cap1)
         tile_b = _clamp_preferred(pb_, tile_b, cap2)
 
-    def shard_index():
-        w = lax.axis_index(axes[0])
-        for ax in axes[1:]:
-            w = w * lax.axis_size(ax) + lax.axis_index(ax)
-        return w
 
     # ---- per-shard data generation (no packing, no transfer) --------- #
     # shard w holds global rows [w*cap, (w+1)*cap): flattening the
@@ -123,7 +122,7 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
     feat = (cfg.dim,) if kernel.kind != "diff" else ()
 
     def gen_body(key):
-        w = shard_index()
+        w = linear_shard_index(axes)
         k1, k2 = jax.random.split(fold(key, "shard", w))
         s1 = jax.random.normal(k1, (1, cap1) + feat, jnp.float32)
         s2 = jax.random.normal(k2, (1, cap2) + feat, jnp.float32)
@@ -145,6 +144,19 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
 
     # ---- estimator bodies (mirror backends.mesh_backend) ------------- #
     def complete_body(a, b, ma, mb, ia, ib):
+        if trip:
+            if len(axes) == 2:
+                s, c = ring.ring_triplet_stats_2d(
+                    kernel, a[0], b[0], mask_x=ma[0], mask_y=mb[0],
+                    ids_x=ia[0], ici_axis=axes[1], dcn_axis=axes[0],
+                    tile=triplet_tile,
+                )
+            else:
+                s, c = ring.ring_triplet_stats(
+                    kernel, a[0], b[0], mask_x=ma[0], mask_y=mb[0],
+                    ids_x=ia[0], axis_name=axes[0], tile=triplet_tile,
+                )
+            return s / c
         kw = dict(tile_a=tile_a, tile_b=tile_b, impl=impl,
                   interpret=interpret)
         # mask=None on padding-free shards certifies the unmasked
@@ -173,6 +185,11 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
         """Per-worker complete statistic on regathered FULL blocks
         ([N, m] with m = n // N — the random remainder is dropped by
         the permutation, so no masks are needed here)."""
+        if trip:
+            s, c = pair_tiles.triplet_stats(
+                kernel, a[0], b[0], ids_x=ia[0], tile=triplet_tile
+            )
+            return (s / c)[None]
         if one_sample:
             s, c = pair_tiles.pair_stats(
                 kernel, a[0], a[0], ids_a=ia[0], ids_b=ib[0],
@@ -221,8 +238,14 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512):
     def incomplete_body(key, a, b):
         """Within-shard sampling on regathered full blocks (the blocks
         a/b arrive padding-free from one_round-style regathers)."""
-        kk = fold(key, "shard", shard_index())
+        kk = fold(key, "shard", linear_shard_index(axes))
         per = -(-cfg.n_pairs // N)
+        if trip:
+            k1, k2 = jax.random.split(kk)
+            i, j = pair_tiles.sample_pair_indices(k1, m1, m1, per, True)
+            kn = jax.random.randint(k2, (per,), 0, m2)
+            vals = kernel.triplet_values(a[0, i], a[0, j], b[0, kn], jnp)
+            return lax.pmean(jnp.mean(vals, dtype=jnp.float32), axes)
         if one_sample:
             i, j = pair_tiles.sample_pair_indices(kk, m1, m1, per, True)
             vals = kernel.pair_elementwise(a[0, i], a[0, j], jnp)

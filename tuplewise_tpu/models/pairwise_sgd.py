@@ -102,10 +102,11 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
                 return pair_tiles.pair_mean_for_grad(
                     kernel, s1, s2, tile_a=cfg.tile, tile_b=cfg.tile
                 )
-            shard = lax.axis_index(axes[0])
-            for ax in axes[1:]:
-                shard = shard * lax.axis_size(ax) + lax.axis_index(ax)
-            kk = fold(key, "pair_sample", shard)
+            from tuplewise_tpu.parallel.device_partition import (
+                linear_shard_index,
+            )
+
+            kk = fold(key, "pair_sample", linear_shard_index(axes))
             i, j = pair_tiles.sample_pair_indices(
                 kk, m1, m2, cfg.pairs_per_worker, one_sample=False
             )

@@ -17,6 +17,19 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def linear_shard_index(axes):
+    """Row-major linearized shard index across one or more mesh axes —
+    THE worker id inside shard_map bodies. One implementation shared by
+    the mesh backend, the trainer, and the mesh-MC harness: per-shard
+    PRNG fold chains (``fold(key, "shard"/"pair_sample", w)``) must
+    derive the same w everywhere or cross-module reproducibility
+    silently breaks."""
+    w = jax.lax.axis_index(axes[0])
+    for ax in axes[1:]:
+        w = w * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+    return w
+
+
 def draw_blocks(key, n: int, n_workers: int, scheme: str = "swor",
                 m: Optional[int] = None) -> jnp.ndarray:
     """[N, m] int32 worker index blocks over range(n).
