@@ -53,7 +53,8 @@ RESULTS = os.path.join(REPO, "results")
 FIGS = os.path.join(RESULTS, "figures")
 
 T0 = time.perf_counter()
-NEVER = 1 << 30   # repartition_every sentinel for "never" (n_r = null)
+# repartition_every sentinel for "never" — shared with the row builder
+from tuplewise_tpu.models.sim_learner import NEVER  # noqa: E402
 QUICK = False     # set by main(); quick output NEVER touches full files
 
 
@@ -107,43 +108,27 @@ def finalize_outputs():
 
 def run_config(scorer, p0, data, cfg, *, n_seeds, eval_every, dataset,
                out_name, platform):
-    """One sweep cell: train S replicas, emit the full curve row."""
-    from tuplewise_tpu.models.sim_learner import train_curves
+    """One sweep cell: train S replicas, emit the full curve row
+    (schema: sim_learner.curve_record + suite provenance fields)."""
+    from tuplewise_tpu.models.sim_learner import curve_record, train_curves
 
     Xp, Xn, Xp_te, Xn_te = data
     t0 = time.perf_counter()
     out = train_curves(scorer, p0, Xp, Xn, Xp_te, Xn_te, cfg,
                        n_seeds=n_seeds, eval_every=eval_every)
     wc = time.perf_counter() - t0
-    auc = out["test_auc"]                       # [S, K]
-    fin = auc[:, -1]
-    se = auc.std(axis=0, ddof=1) / np.sqrt(n_seeds)
-    n_r = None if cfg.repartition_every >= NEVER else cfg.repartition_every
-    rec = {
-        "dataset": dataset,
-        "kernel": cfg.kernel, "lr": cfg.lr, "steps": cfg.steps,
-        "n_workers": cfg.n_workers, "n_r": n_r,
-        "repartition_every": cfg.repartition_every,
-        "pairs_per_worker": cfg.pairs_per_worker,
-        "n_seeds": n_seeds, "seed0": cfg.seed,
-        "n_train": [len(Xp), len(Xn)],
-        "n_test": [len(Xp_te), len(Xn_te)],
-        "m_per_worker": [len(Xp) // cfg.n_workers,
-                         len(Xn) // cfg.n_workers],
-        # 1 initial partition + one event per later boundary
-        "comm_events": 1 + (cfg.steps - 1) // cfg.repartition_every,
-        "eval_steps": out["steps"].tolist(),
-        "auc_mean": np.round(auc.mean(axis=0), 6).tolist(),
-        "auc_se": np.round(se, 7).tolist(),
-        "final_auc_mean": float(fin.mean()),
-        "final_auc_se": float(fin.std(ddof=1) / np.sqrt(n_seeds)),
-        "final_auc_sd": float(fin.std(ddof=1)),
-        "loss_final_mean": float(out["loss"][:, -1].mean()),
-        "wallclock_s": round(wc, 2),
-        "platform": platform,
-    }
+    rec = dict(
+        curve_record(cfg, out, n_seeds),
+        dataset=dataset, seed0=cfg.seed,
+        n_train=[len(Xp), len(Xn)],
+        n_test=[len(Xp_te), len(Xn_te)],
+        m_per_worker=[len(Xp) // cfg.n_workers,
+                      len(Xn) // cfg.n_workers],
+        wallclock_s=round(wc, 2), platform=platform,
+    )
     emit(rec, out_name)
-    log(f"{dataset} N={cfg.n_workers} n_r={n_r} B={cfg.pairs_per_worker} "
+    log(f"{dataset} N={cfg.n_workers} n_r={rec['n_r']} "
+        f"B={cfg.pairs_per_worker} "
         f"final={rec['final_auc_mean']:.5f}+-{rec['final_auc_se']:.5f} "
         f"sd={rec['final_auc_sd']:.5f} ({wc:.1f}s)")
     return rec

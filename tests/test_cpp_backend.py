@@ -121,3 +121,43 @@ def test_faster_than_numpy(scores):
     # min-of-3 on both sides: robust to scheduler hiccups on loaded boxes
     assert best_of(lambda: ec.complete(s1, s2)) <= 1.5 * best_of(
         lambda: en.complete(s1, s2))
+
+
+class TestTripletParity:
+    """Degree-3 native path [r3]: the C++ triple loop mirrors
+    NumpyBackend._triplet_stats (same i!=j id exclusion, same squared
+    distances), so every scheme matches the oracle near-exactly."""
+
+    @pytest.fixture(scope="class")
+    def feats(self):
+        rng = np.random.default_rng(11)
+        return rng.standard_normal((40, 4)), rng.standard_normal((36, 4))
+
+    @pytest.mark.parametrize(
+        "kern", ["triplet_indicator", "triplet_hinge"]
+    )
+    def test_complete(self, feats, kern):
+        X, Y = feats
+        ref = Estimator(kern, backend="numpy").complete(X, Y)
+        got = Estimator(kern, backend="cpp").complete(X, Y)
+        assert got == pytest.approx(ref, rel=1e-12)
+
+    def test_local_average_same_partitions(self, feats):
+        X, Y = feats
+        ref = Estimator("triplet_hinge", backend="numpy", n_workers=4)
+        got = Estimator("triplet_hinge", backend="cpp", n_workers=4)
+        for seed in range(3):
+            assert got.local_average(X, Y, seed=seed) == pytest.approx(
+                ref.local_average(X, Y, seed=seed), rel=1e-12)
+
+    @pytest.mark.parametrize("design", ["swr", "swor", "bernoulli"])
+    def test_incomplete_designs(self, feats, design):
+        """Incomplete sampling inherits the shared host sampler, so
+        tuple sets are identical at a seed (the kernel evaluation is
+        NumPy either way — only complete/local hit the native loop)."""
+        X, Y = feats
+        a = Estimator("triplet_indicator", backend="numpy").incomplete(
+            X, Y, n_pairs=2000, seed=3, design=design)
+        b = Estimator("triplet_indicator", backend="cpp").incomplete(
+            X, Y, n_pairs=2000, seed=3, design=design)
+        assert a == pytest.approx(b, rel=1e-12)

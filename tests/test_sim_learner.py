@@ -118,3 +118,23 @@ class TestCurves:
         with pytest.raises(ValueError, match="too small"):
             train_curves(scorer, scorer.init(0), Xp, Xn, Xp_te, Xn_te,
                          cfg, n_seeds=1)
+
+
+def test_cli_learning_subcommand(capsys):
+    """The L6 surface covers the learning trade-off: one sweep cell via
+    the CLI, emitting the same row schema as scripts/learning_suite."""
+    import json
+
+    from tuplewise_tpu.harness.cli import main
+
+    rc = main([
+        "learning", "--n", "256", "--steps", "20", "--n-workers", "16",
+        "--repartition-every", "5", "--n-seeds", "2",
+        "--eval-every", "10",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["n_r"] == 5
+    assert rec["comm_events"] == 1 + 19 // 5
+    assert len(rec["eval_steps"]) == len(rec["auc_mean"]) == 3
+    assert 0.0 <= rec["final_auc_mean"] <= 1.0

@@ -8,9 +8,10 @@ stays untouched [SURVEY §6 "self-baseline"]; this backend exists so the
 reference path itself has a serious native runtime, and as the fast
 host-side check for large-n parity runs.
 
-Falls back kernel-by-kernel: diff kernels (auc/hinge/logistic) and the
-scatter kernel dispatch to C++; anything else (triplets, user-registered
-Python kernels) runs the inherited NumPy path, so every kernel works.
+Falls back kernel-by-kernel: diff kernels (auc/hinge/logistic), the
+scatter kernel, and the degree-3 triplet kernels dispatch to C++;
+anything else (user-registered Python kernels) runs the inherited
+NumPy path, so every kernel works.
 """
 
 from __future__ import annotations
@@ -25,6 +26,8 @@ from tuplewise_tpu.backends.numpy_backend import NumpyBackend
 from tuplewise_tpu.ops.kernels import Kernel
 
 _DIFF_IDS = {"auc": 0, "hinge": 1, "logistic": 2}
+# (native kernel id, margin) — mirrors ops/kernels.py triplet defaults
+_TRIPLET_IDS = {"triplet_indicator": (0, 0.0), "triplet_hinge": (1, 1.0)}
 
 
 def _i64p(x: Optional[np.ndarray]):
@@ -91,3 +94,26 @@ class CppBackend(NumpyBackend):
 
         # unknown/custom kernels: inherited pure-NumPy blockwise path
         return super()._pair_stats(A, B, ids_a, ids_b)
+
+    def _triplet_stats(
+        self,
+        X: np.ndarray,
+        Y: np.ndarray,
+        ids_x: Optional[np.ndarray] = None,
+    ) -> Tuple[float, int]:
+        spec = _TRIPLET_IDS.get(self.kernel.name)
+        if spec is None:  # custom triplet kernels: NumPy path
+            return super()._triplet_stats(X, Y, ids_x)
+        x = np.ascontiguousarray(np.atleast_2d(X), np.float64)
+        y = np.ascontiguousarray(np.atleast_2d(Y), np.float64)
+        ids = np.ascontiguousarray(
+            np.arange(len(x)) if ids_x is None else ids_x, np.int64
+        )
+        out_sum = ctypes.c_double()
+        out_count = ctypes.c_int64()
+        self._lib.triplet_stats_native(
+            spec[0], ctypes.c_double(spec[1]),
+            _dp(x), x.shape[0], _dp(y), y.shape[0], x.shape[1],
+            _i64p(ids), ctypes.byref(out_sum), ctypes.byref(out_count),
+        )
+        return out_sum.value, int(out_count.value)

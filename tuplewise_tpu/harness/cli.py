@@ -6,6 +6,7 @@
     python -m tuplewise_tpu.harness.cli tradeoff-workers --workers 8 1000 125000
     python -m tuplewise_tpu.harness.cli triplet --n 2000
     python -m tuplewise_tpu.harness.cli train --dataset adult --steps 100
+    python -m tuplewise_tpu.harness.cli learning --n-workers 128 --repartition-every 25
 
 Each command prints JSON to stdout and can append JSONL via --out
 [SURVEY §2 L6, §5.6].
@@ -87,6 +88,28 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", type=str, default=None)
 
+    p = sub.add_parser(
+        "learning",
+        help="one learning-trade-off cell: simulated-N distributed SGD "
+             "with Monte-Carlo seeds and held-out AUC curves",
+    )
+    p.add_argument("--dataset", choices=["gaussians", "adult"],
+                   default="gaussians")
+    p.add_argument("--kernel", default="hinge")
+    p.add_argument("--lr", type=float, default=0.3)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--n-workers", type=int, default=32)
+    p.add_argument("--repartition-every", type=int, default=10,
+                   help="0 = never repartition")
+    p.add_argument("--pairs-per-worker", type=int, default=None)
+    p.add_argument("--n-seeds", type=int, default=8)
+    p.add_argument("--eval-every", type=int, default=20)
+    p.add_argument("--n", type=int, default=1024,
+                   help="gaussians: train rows per class; adult: total")
+    p.add_argument("--n-test", type=int, default=8000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", type=str, default=None)
+
     p = sub.add_parser("train")
     p.add_argument("--dataset", choices=["gaussians", "adult"],
                    default="adult")
@@ -132,6 +155,46 @@ def main(argv=None) -> int:
             triplet_mnist_statistic(
                 kernel=args.kernel, backend=args.backend, n=args.n,
                 n_pairs=args.n_pairs, seed=args.seed,
+            ),
+            args.out,
+        )
+    elif args.cmd == "learning":
+        from tuplewise_tpu.data import load_adult_splits, make_gaussian_splits
+        from tuplewise_tpu.models.pairwise_sgd import TrainConfig, split_by_label
+        from tuplewise_tpu.models.scorers import LinearScorer
+        from tuplewise_tpu.models.sim_learner import (
+            NEVER, curve_record, train_curves,
+        )
+
+        if args.dataset == "adult":
+            X, y, Xte, yte, meta = load_adult_splits(
+                n=args.n, seed=args.seed
+            )
+            Xp, Xn = split_by_label(X, y)
+            Xp_te, Xn_te = split_by_label(Xte, yte)
+        else:
+            Xp, Xn, Xp_te, Xn_te = make_gaussian_splits(
+                args.n, args.n_test, dim=10, separation=0.8,
+                seed=args.seed,
+            )
+            meta = {"synthetic": True, "source": "gaussians"}
+        scorer = LinearScorer(dim=Xp.shape[1])
+        cfg = TrainConfig(
+            kernel=args.kernel, lr=args.lr, steps=args.steps,
+            n_workers=args.n_workers,
+            repartition_every=args.repartition_every or NEVER,
+            pairs_per_worker=args.pairs_per_worker, seed=args.seed,
+        )
+        out = train_curves(
+            scorer, scorer.init(args.seed), Xp, Xn, Xp_te, Xn_te, cfg,
+            n_seeds=args.n_seeds, eval_every=args.eval_every,
+        )
+        _emit(
+            dict(
+                curve_record(cfg, out, args.n_seeds),
+                config=dataclasses.asdict(cfg),
+                dataset=args.dataset,
+                data_meta=meta,
             ),
             args.out,
         )

@@ -40,6 +40,50 @@ from tuplewise_tpu.ops.rank_auc import rank_auc
 from tuplewise_tpu.parallel.device_partition import draw_blocks
 from tuplewise_tpu.utils.rng import fold, root_key
 
+# TrainConfig.repartition_every sentinel for "never repartition";
+# curve_record maps it to n_r = null in emitted rows
+NEVER = 1 << 30
+
+
+def curve_record(cfg, out, n_seeds: int) -> dict:
+    """Summary row for one :func:`train_curves` cell — the ONE copy of
+    the row schema shared by scripts/learning_suite.py and the CLI
+    ``learning`` subcommand (n_r null-mapping, comm_events accounting,
+    rounding, and the seed-spread statistics).
+
+    With n_seeds < 2 the spread fields are null (a sample SD over one
+    replica is undefined — emitting NaN would produce invalid JSON).
+    """
+    auc = out["test_auc"]                        # [S, K]
+    fin = auc[:, -1]
+    if n_seeds >= 2:
+        auc_se = np.round(
+            auc.std(axis=0, ddof=1) / np.sqrt(n_seeds), 7
+        ).tolist()
+        final_se = float(fin.std(ddof=1) / np.sqrt(n_seeds))
+        final_sd = float(fin.std(ddof=1))
+    else:
+        auc_se = [None] * auc.shape[1]
+        final_se = final_sd = None
+    return {
+        "kernel": cfg.kernel, "lr": cfg.lr, "steps": cfg.steps,
+        "n_workers": cfg.n_workers,
+        "n_r": (None if cfg.repartition_every >= NEVER
+                else cfg.repartition_every),
+        "repartition_every": cfg.repartition_every,
+        "pairs_per_worker": cfg.pairs_per_worker,
+        "n_seeds": n_seeds,
+        # 1 initial partition + one event per later boundary
+        "comm_events": 1 + (cfg.steps - 1) // cfg.repartition_every,
+        "eval_steps": out["steps"].tolist(),
+        "auc_mean": np.round(auc.mean(axis=0), 6).tolist(),
+        "auc_se": auc_se,
+        "final_auc_mean": float(fin.mean()),
+        "final_auc_se": final_se,
+        "final_auc_sd": final_sd,
+        "loss_final_mean": float(out["loss"][:, -1].mean()),
+    }
+
 
 @functools.lru_cache(maxsize=32)
 def _compiled_sim_trainer(scorer, cfg, n1, n2):

@@ -69,6 +69,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c_i64p, c_i64p, ctypes.c_int, c_dp, c_i64p,
     ]
     lib.pair_stats_scatter.restype = None
+    lib.triplet_stats_native.argtypes = [
+        ctypes.c_int, ctypes.c_double, c_dp, ctypes.c_int64, c_dp,
+        ctypes.c_int64, ctypes.c_int64, c_i64p, c_dp, c_i64p,
+    ]
+    lib.triplet_stats_native.restype = None
     lib.native_num_threads.argtypes = []
     lib.native_num_threads.restype = ctypes.c_int
     return lib

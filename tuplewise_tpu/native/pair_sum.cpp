@@ -138,6 +138,75 @@ void pair_stats_scatter(const double* a, int64_t n1, const double* b,
     fold_rows(rows, out_sum, out_count);
 }
 
+// (sum, count) of the degree-3 metric-learning kernel
+// h(x_i, x_j, y_k) over ids_x[i] != ids_x[j] (anchor/positive
+// exclusion), all k — mirroring NumpyBackend._triplet_stats exactly.
+// kernel_id: 0 = indicator 1{d(a,n) > d(a,p) + margin},
+//            1 = hinge max(0, margin + d(a,p) - d(a,n)),
+// with d = SQUARED euclidean distance (ops/kernels.py semantics).
+// Per anchor i, the n2 anchor-negative distances are computed once
+// (O(n2 d)) and reused across all positives j, so the triple loop
+// costs O(n1^2 n2 + n1 n2 d) instead of O(n1^2 n2 d).
+void triplet_stats_native(int kernel_id, double margin, const double* x,
+                          int64_t n1, const double* y, int64_t n2,
+                          int64_t dim, const int64_t* ids_x,
+                          double* out_sum, int64_t* out_count) {
+    std::vector<Acc> rows(static_cast<size_t>(n1));
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n1; ++i) {
+        const double* xi = x + i * dim;
+        std::vector<double> dan(static_cast<size_t>(n2));
+        for (int64_t kk = 0; kk < n2; ++kk) {
+            const double* yk = y + kk * dim;
+            double d2 = 0.0;
+            for (int64_t d = 0; d < dim; ++d) {
+                const double diff = xi[d] - yk[d];
+                d2 += diff * diff;
+            }
+            dan[static_cast<size_t>(kk)] = d2;
+        }
+        double s = 0.0, comp = 0.0;
+        int64_t c = 0;
+        for (int64_t j = 0; j < n1; ++j) {
+            if (ids_x[j] == ids_x[i]) continue;
+            const double* xj = x + j * dim;
+            double dap = 0.0;
+            for (int64_t d = 0; d < dim; ++d) {
+                const double diff = xi[d] - xj[d];
+                dap += diff * diff;
+            }
+            // plain f64 sum over the n2 negatives (values are O(1), so
+            // a block of <=1e7 terms keeps ~1e-10 relative error), then
+            // ONE Kahan add per (i, j): a Kahan chain in the innermost
+            // loop would serialize it on the compensation dependency
+            double block = 0.0;
+            if (kernel_id == 0) {
+                const double thresh = dap + margin;
+                for (int64_t kk = 0; kk < n2; ++kk) {
+                    block += dan[static_cast<size_t>(kk)] > thresh
+                                 ? 1.0 : 0.0;
+                }
+            } else {
+                const double base = margin + dap;
+                for (int64_t kk = 0; kk < n2; ++kk) {
+                    const double h = base - dan[static_cast<size_t>(kk)];
+                    block += h > 0.0 ? h : 0.0;
+                }
+            }
+            double yv = block - comp;
+            double t = s + yv;
+            comp = (t - s) - yv;
+            s = t;
+            c += n2;
+        }
+        rows[static_cast<size_t>(i)].sum = s - comp;
+        rows[static_cast<size_t>(i)].count = c;
+    }
+    fold_rows(rows, out_sum, out_count);
+}
+
 int native_num_threads() {
 #ifdef _OPENMP
     return omp_get_max_threads();
