@@ -300,7 +300,11 @@ def main():
     global QUICK
     QUICK = args.quick
     os.makedirs(RESULTS, exist_ok=True)
-    path = os.path.join(RESULTS, "configs.jsonl")
+    from tuplewise_tpu.utils.results_io import quick_sibling
+
+    # quick runs write a sibling file: a smoke run must never replace
+    # the committed full-run rows (rule shared via utils.results_io)
+    path = os.path.join(RESULTS, quick_sibling("configs.jsonl", QUICK))
     wanted = set(args.configs.split(","))
     fns = {"1": config1, "2": config2, "2b": config2b, "3": config3,
            "4": config4, "5": config5}

@@ -66,14 +66,14 @@ def log(msg):
 _touched = set()
 
 
+from tuplewise_tpu.utils.results_io import quick_sibling  # noqa: E402
+
+
 def _quick_name(name: str) -> str:
-    """The one copy of the quick-suffix rule: quick runs write to
-    *_quick siblings (JSONL and figures alike) so a smoke test can
-    never truncate/replace committed full-run artifacts."""
-    if QUICK:
-        stem, ext = os.path.splitext(name)
-        name = f"{stem}_quick{ext}"
-    return name
+    """Quick runs write to *_quick siblings (JSONL and figures alike)
+    so a smoke test can never truncate/replace committed artifacts —
+    rule shared via utils.results_io."""
+    return quick_sibling(name, QUICK)
 
 
 def _out_path(name: str) -> str:
@@ -127,10 +127,14 @@ def run_config(scorer, p0, data, cfg, *, n_seeds, eval_every, dataset,
         wallclock_s=round(wc, 2), platform=platform,
     )
     emit(rec, out_name)
+
+    def fmt(v):   # null-safe: n_seeds=1 rows carry no spread estimate
+        return "n/a" if v is None else f"{v:.5f}"
+
     log(f"{dataset} N={cfg.n_workers} n_r={rec['n_r']} "
         f"B={cfg.pairs_per_worker} "
-        f"final={rec['final_auc_mean']:.5f}+-{rec['final_auc_se']:.5f} "
-        f"sd={rec['final_auc_sd']:.5f} ({wc:.1f}s)")
+        f"final={rec['final_auc_mean']:.5f}+-{fmt(rec['final_auc_se'])} "
+        f"sd={fmt(rec['final_auc_sd'])} ({wc:.1f}s)")
     return rec
 
 
@@ -340,6 +344,7 @@ def stage_trace(q, platform):
 def stage_figs():
     from tuplewise_tpu.harness.figures import (
         plot_auc_vs_budget, plot_auc_vs_comm, plot_learning_curves,
+        plot_sd_vs_comm,
     )
 
     os.makedirs(FIGS, exist_ok=True)
@@ -371,6 +376,11 @@ def stage_figs():
             rows,
             fig_path(f"learning_auc_vs_comm_{dataset}.png"),
             title=f"{dataset}: final held-out AUC vs communication",
+        )
+        plot_sd_vs_comm(
+            rows,
+            fig_path(f"learning_sd_vs_comm_{dataset}.png"),
+            title=f"{dataset}: partition-induced spread vs communication",
         )
     # pair-budget sweep figure: B rows + the matching all-pairs rows
     gauss = load("learning_gauss.jsonl")

@@ -46,12 +46,26 @@ def log(msg):
 
 T0 = time.perf_counter()
 
+QUICK = False    # set by main(); quick output never touches full files
+
+from tuplewise_tpu.utils.results_io import (  # noqa: E402
+    is_quick, quick_sibling, strip_quick,
+)
+
+
+def _qname(name: str) -> str:
+    return quick_sibling(name, QUICK)
+
+
+def _out(name: str) -> str:
+    return os.path.join(RESULTS, _qname(name))
+
 
 _touched = set()
 
 
 def run(cfg, out, chunk=None, trace_dir=None):
-    path = os.path.join(RESULTS, out)
+    path = _out(out)
     # write_jsonl appends; truncate each output once per invocation so
     # re-running a stage (e.g. after a crash) never duplicates rows
     if path not in _touched:
@@ -71,14 +85,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--stages", type=str,
-                    default="1e6,1e7,tradeoff,mesh,exact,figs",
+                    default="1e6,1e7,tradeoff,mesh,exact,scale8,figs",
                     help="comma list of stages to run (the default runs "
                          "everything RESULTS.md commits: the production "
                          "scales, the visible-trade-off regime, the mesh "
-                         "ring, and the exact rank-AUC series)")
+                         "ring, the exact rank-AUC series, and the "
+                         "n=10^8 scale demo)")
     args = ap.parse_args()
+    global QUICK
+    QUICK = args.quick
     stages = set(args.stages.split(","))
-    known = {"1e6", "1e7", "tradeoff", "mesh", "exact", "figs"}
+    known = {"1e6", "1e7", "tradeoff", "mesh", "exact", "scale8", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}; "
                  f"choose from {sorted(known)}")
@@ -173,7 +190,7 @@ def main():
                 for N in ((4,) if q else (125000, 250000))
             },
         }
-        with open(os.path.join(RESULTS, "tradeoff_theory.json"), "w") as f:
+        with open(_out("tradeoff_theory.json"), "w") as f:
             json.dump(theory, f, indent=1)
         log("tradeoff stage done (theory overlay written)")
 
@@ -256,12 +273,84 @@ def main():
                 "vmapped": True,
                 "n_reps": M,
             }
-            path = os.path.join(RESULTS, f"exact_{scale}.jsonl")
+            path = _out(f"exact_{scale}.jsonl")
             if os.path.exists(path):
                 os.remove(path)
             write_jsonl([row], path)
             log(f"exact_{scale}: var={row['variance']:.3e} "
                 f"wc={wc:.3f}s for M={M} ({wc / M * 1e3:.1f} ms/rep)")
+
+    if "scale8" in stages:
+        # n = 10^8 TOTAL samples — one decade past the headline scale.
+        # The complete grid is 2.5e15 pairs (~80 min/rep streamed), so
+        # the tractable paths at this n are the O(n log n) exact rank
+        # statistic and the incomplete family: exactly the regime the
+        # paper argues for. Chunked reps bound HBM (one rep's 400 MB of
+        # scores live at a time for the exact path; incomplete chunks
+        # by 4).
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tuplewise_tpu.ops.rank_auc import rank_auc
+        from tuplewise_tpu.utils.rng import fold, root_key
+
+        n8 = 4_000 if q else 50_000_000     # per class; 10^8 total
+        M8 = 2 if q else 8
+        log(f"== stage scale8 (n_pos=n_neg={n8}, M={M8}) ==")
+
+        # ONE rep per dispatch, looped on the host: the XLA sort at
+        # n=5e7 runs ~60 s, and a single lax.map program spanning all
+        # reps exceeded what the axon tunnel worker tolerates (it
+        # crashed mid-program); per-rep dispatches are each bounded
+        @jax.jit
+        def one_rep8(rep, n=n8):
+            key = fold(root_key(0), "mc_rep", rep)
+            k1, k2 = jax.random.split(fold(key, "data"))
+            s1 = jax.random.normal(k1, (n,), jnp.float32) + 1.0
+            s2 = jax.random.normal(k2, (n,), jnp.float32)
+            return rank_auc(s1, s2)
+
+        float(one_rep8(jnp.asarray(0)))       # compile outside the timer
+        ests, wc = [], 0.0
+        for rep in range(M8):
+            t0 = time.perf_counter()
+            ests.append(float(one_rep8(jnp.asarray(rep))))
+            wc += time.perf_counter() - t0
+            log(f"  scale8 exact rep {rep + 1}/{M8}")
+        ests = np.asarray(ests)
+        row = {
+            "config": {
+                "kernel": "auc", "scheme": "complete",
+                "estimator": "rank_auc_exact", "backend": "jax",
+                "n_pos": n8, "n_neg": n8, "dim": 1,
+                "separation": 1.0, "n_workers": 1, "n_rounds": 1,
+                "n_pairs": 0, "partition_scheme": "swor",
+                "n_reps": M8, "seed": 0,
+            },
+            "mean": float(ests.mean()),
+            "variance": float(ests.var(ddof=1)),
+            "std_error": float(ests.std(ddof=1) / np.sqrt(M8)),
+            # NOT a vmapped/lax.map program: one jitted dispatch per
+            # rep (see comment above) — stamp provenance honestly
+            "wallclock_s": wc, "vmapped": False,
+            "dispatch": "per_rep_jit", "n_reps": M8,
+        }
+        path = _out("exact_n1e8.jsonl")
+        if os.path.exists(path):
+            os.remove(path)
+        write_jsonl([row], path)
+        log(f"exact_n1e8: var={row['variance']:.3e} wc={wc:.1f}s "
+            f"({wc / M8 * 1e3:.0f} ms/rep)")
+
+        base8 = VarianceConfig(n_pos=n8, n_neg=n8, n_workers=8,
+                               n_reps=M8)
+        for B in (100_000, 10_000_000, 100_000_000):
+            if q and B > 100_000:
+                continue
+            run(dataclasses.replace(
+                    base8, scheme="incomplete", n_pairs=B),
+                "pairs_n1e8.jsonl", chunk=None if q else 4)
 
     if "figs" in stages:
         log("== stage figures ==")
@@ -272,13 +361,16 @@ def main():
         )
 
         def load(name):
-            p = os.path.join(RESULTS, name)
+            p = _out(name)
             if not os.path.exists(p):
                 return []
             with open(p) as f:
                 return [json.loads(x) for x in f if x.strip()]
 
         figs = os.path.join(RESULTS, "figures")
+
+        def fig(name):
+            return os.path.join(figs, _qname(name))
         for scale in ("n1e6", "n1e7"):
             rounds = load(f"rounds_{scale}.jsonl")
             var = load(f"variance_{scale}.jsonl")
@@ -290,16 +382,16 @@ def main():
             )
             if rounds:
                 plot_variance_vs_rounds(
-                    rounds, os.path.join(figs, f"var_vs_rounds_{scale}.png"),
+                    rounds, fig(f"var_vs_rounds_{scale}.png"),
                     baseline=comp,
                 )
                 plot_variance_vs_wallclock(
                     rounds + ([comp] if comp else []),
-                    os.path.join(figs, f"var_vs_wallclock_{scale}.png"),
+                    fig(f"var_vs_wallclock_{scale}.png"),
                 )
             if pairs:
                 plot_variance_vs_pairs(
-                    pairs, os.path.join(figs, f"var_vs_pairs_{scale}.png"),
+                    pairs, fig(f"var_vs_pairs_{scale}.png"),
                 )
             if var or rounds or pairs:
                 plot_frontier(
@@ -318,11 +410,11 @@ def main():
                         # generic kernels [VERDICT r2 next #6]
                         "exact rank-AUC ($O(n\\log n)$)": exact,
                     },
-                    os.path.join(figs, f"frontier_{scale}.png"),
+                    fig(f"frontier_{scale}.png"),
                 )
         # trade-off-regime figures with the closed-form overlay
         tthe = {}
-        tpath = os.path.join(RESULTS, "tradeoff_theory.json")
+        tpath = _out("tradeoff_theory.json")
         if os.path.exists(tpath):
             with open(tpath) as f:
                 tthe = json.load(f)
@@ -331,18 +423,25 @@ def main():
         workers = load("tradeoff_workers.jsonl")
         if workers:
             plot_variance_vs_workers(
-                workers, os.path.join(figs, "var_vs_workers.png"),
+                workers, fig("var_vs_workers.png"),
                 baseline=tcomp, theory=tthe.get("workers"),
             )
         for name in sorted(os.listdir(RESULTS)):
-            if name.startswith("tradeoff_rounds_N"):
-                N = name[len("tradeoff_rounds_N"):-len(".jsonl")]
-                plot_variance_vs_rounds(
-                    load(name),
-                    os.path.join(figs, f"var_vs_rounds_N{N}.png"),
-                    baseline=tcomp,
-                    theory=(tthe.get("rounds") or {}).get(N),
-                )
+            if not name.startswith("tradeoff_rounds_N"):
+                continue
+            # quick-suffixed inputs pair with quick-suffixed figures;
+            # a quick figs run never reads (or overwrites) full data
+            if is_quick(name) != QUICK:
+                continue
+            base = strip_quick(name)
+            N = base[len("tradeoff_rounds_N"):-len(".jsonl")]
+            plot_variance_vs_rounds(
+                # load() re-applies the quick suffix to base names
+                load(base),
+                fig(f"var_vs_rounds_N{N}.png"),
+                baseline=tcomp,
+                theory=(tthe.get("rounds") or {}).get(N),
+            )
         log("figures written to results/figures/")
 
     log("done")

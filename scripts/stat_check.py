@@ -73,7 +73,13 @@ def predicted_variance(cfg: dict) -> float | None:
 
 def main(out: str | None = None) -> int:
     rows, worst = [], 0.0
-    paths = sorted(glob.glob(os.path.join(REPO, "results", "*.jsonl")))
+    from tuplewise_tpu.utils.results_io import is_quick
+
+    # *_quick.jsonl smoke-run siblings never enter the committed audit
+    paths = sorted(
+        p for p in glob.glob(os.path.join(REPO, "results", "*.jsonl"))
+        if not is_quick(os.path.basename(p))
+    )
     for path in paths:
         name = os.path.basename(path)
         for line in open(path):

@@ -138,3 +138,41 @@ def test_cli_learning_subcommand(capsys):
     assert rec["comm_events"] == 1 + 19 // 5
     assert len(rec["eval_steps"]) == len(rec["auc_mean"]) == 3
     assert 0.0 <= rec["final_auc_mean"] <= 1.0
+
+
+def test_learning_figures_render(tmp_path):
+    """All four learning-trade-off figure kinds render from suite-shaped
+    rows (incl. null-SE rows and the B=None all-pairs star)."""
+    from tuplewise_tpu.harness.figures import (
+        plot_auc_vs_budget, plot_auc_vs_comm, plot_learning_curves,
+        plot_sd_vs_comm,
+    )
+
+    def row(nr, N=32, B=None, sd=1e-3):
+        re_ = nr if nr is not None else 1 << 30
+        return {
+            "n_r": nr, "n_workers": N, "pairs_per_worker": B,
+            "m_per_worker": [4, 4],
+            "comm_events": 1 + 99 // re_,
+            "eval_steps": [0, 50, 100],
+            "auc_mean": [0.5, 0.7, 0.71],
+            "auc_se": [0.0, 1e-3, 1e-3],
+            "final_auc_mean": 0.71, "final_auc_se": sd / 2,
+            "final_auc_sd": sd,
+        }
+
+    null_se = row(5)   # an n_seeds=1 row: no spread estimate anywhere
+    null_se["auc_se"] = [None, None, None]
+    null_se["final_auc_se"] = None
+    null_se["final_auc_sd"] = None
+    rows = [row(1), row(25), row(None, sd=3e-3), null_se]
+    budget = [row(1, B=4), row(None, B=4), row(1), row(None)]
+    import os
+
+    for p in (
+        plot_learning_curves(rows, str(tmp_path / "c.png")),
+        plot_auc_vs_comm(rows, str(tmp_path / "a.png")),
+        plot_sd_vs_comm(rows, str(tmp_path / "s.png")),
+        plot_auc_vs_budget(budget, str(tmp_path / "b.png")),
+    ):
+        assert os.path.getsize(p) > 1000

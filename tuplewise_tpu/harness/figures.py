@@ -205,7 +205,11 @@ def plot_learning_curves(rows, out_png: str, title: str = "") -> str:
                                            r.get("n_r") or 0)):
         s = np.asarray(row["eval_steps"])
         mu = np.asarray(row["auc_mean"])
-        se = np.asarray(row["auc_se"])
+        # n_seeds=1 rows carry null SEs (no spread estimate): plot the
+        # mean with a zero-width band rather than crashing
+        se = np.asarray(
+            [0.0 if v is None else v for v in row["auc_se"]], float
+        )
         (ln,) = ax.plot(s, mu, lw=1.4, label=_nr_label(row))
         ax.fill_between(s, mu - 2 * se, mu + 2 * se,
                         color=ln.get_color(), alpha=0.18, lw=0)
@@ -248,7 +252,7 @@ def plot_auc_vs_comm(rows, out_png: str, title: str = "") -> str:
         rs = sorted(rs, key=lambda r: r["comm_events"])
         x = [r["comm_events"] for r in rs]
         y = [r["final_auc_mean"] for r in rs]
-        e = [2 * r["final_auc_se"] for r in rs]
+        e = [2 * (r["final_auc_se"] or 0.0) for r in rs]
         ax.errorbar(x, y, yerr=e, marker="o", ms=4, lw=1.2, capsize=2,
                     label=f"N={N}")
     ax.set_xscale("log")
@@ -293,14 +297,15 @@ def plot_auc_vs_budget(rows, out_png: str, title: str = "") -> str:
         if sampled:
             x = [r["pairs_per_worker"] for r in sampled]
             y = [r["final_auc_mean"] for r in sampled]
-            e = [2 * r["final_auc_se"] for r in sampled]
+            e = [2 * (r["final_auc_se"] or 0.0) for r in sampled]
             eb = ax.errorbar(x, y, yerr=e, marker="o", ms=4, lw=1.2,
                              capsize=2, label=_nr_label(rs[0]))
             color = eb.lines[0].get_color()
         for r in full:
             ax.errorbar(
                 [r["m_per_worker"][0] * r["m_per_worker"][1]],
-                [r["final_auc_mean"]], yerr=[2 * r["final_auc_se"]],
+                [r["final_auc_mean"]],
+                yerr=[2 * (r["final_auc_se"] or 0.0)],
                 marker="*", ms=11, capsize=2, color=color,
                 label=None if sampled else _nr_label(r),
             )
@@ -310,6 +315,41 @@ def plot_auc_vs_budget(rows, out_png: str, title: str = "") -> str:
     if title:
         ax.set_title(title, fontsize=9)
     ax.legend(fontsize=8, title="repartition every", title_fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png
+
+
+def plot_sd_vs_comm(rows, out_png: str, title: str = "") -> str:
+    """Across-seed SD of the final model vs communication events — the
+    learning analogue of the estimator's variance-vs-T decay (RESULTS
+    §6.1 finding 2). No closed-form guide is drawn: unlike the
+    repartitioned ESTIMATOR (which averages all T rounds equally), a
+    constant-lr SGD iterate only averages partitions inside its
+    O(1/lr)-step memory, so the decay starts slower than T^(-1/2) and
+    steepens once repartitions outpace that window — exactly what the
+    measured curves show."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = [r for r in _results(rows) if r.get("final_auc_sd")]
+    fig, ax = plt.subplots(figsize=(5.5, 4))
+    by_n = {}
+    for r in rows:
+        by_n.setdefault(r["n_workers"], []).append(r)
+    for N, rs in sorted(by_n.items()):
+        rs = sorted(rs, key=lambda r: r["comm_events"])
+        x = [r["comm_events"] for r in rs]
+        y = [r["final_auc_sd"] for r in rs]
+        ax.loglog(x, y, "o-", ms=4, lw=1.2, label=f"N={N}")
+    ax.set_xlabel("communication events (repartitions)")
+    ax.set_ylabel("SD of final held-out AUC across partitions")
+    if title:
+        ax.set_title(title, fontsize=9)
+    ax.legend(fontsize=8, title="workers", title_fontsize=8)
     fig.tight_layout()
     fig.savefig(out_png, dpi=150)
     plt.close(fig)
