@@ -6,9 +6,13 @@ ring, on-device repartitioning, psum — over 8 virtual CPU devices and
 Monte-Carlos each scheme, so the committed JSONL shows the N=8
 distributed estimators producing the same statistics the closed forms
 predict (unbiased means, ordered variances), not just passing unit
-tests. Run:
+tests. A second section (r3) runs the 2-D (dcn=2 x ici=4) HIERARCHICAL
+double ring and the non-diff kernel kinds (scatter one-sample with
+global-id exclusion; degree-3 triplet double ring) through the
+mesh-native MC runner, so the multi-host layout and the full
+kernel-kind matrix have committed statistics too. Run:
 
-    python scripts/mesh8_cpu.py          # writes results/mesh8_cpu.jsonl
+    python scripts/mesh8_cpu.py     # results/mesh8_cpu.jsonl + mesh8_2d_cpu.jsonl
 """
 
 from __future__ import annotations
@@ -63,6 +67,66 @@ def main():
             "scheme": cfg.scheme, "T": cfg.n_rounds, "B": cfg.n_pairs,
             "mean": round(r["mean"], 6),
             "variance": r["variance"],
+        }), flush=True)
+    print(f"# wrote {out} in {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    main_2d_and_kernels()
+
+
+def main_2d_and_kernels():
+    """2-D hierarchical ring + non-diff kernel kinds, mesh-native MC."""
+    import numpy as np
+
+    from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
+    from tuplewise_tpu.parallel.mesh import make_mesh_2d
+
+    out = os.path.join(REPO, "results", "mesh8_2d_cpu.jsonl")
+    if os.path.exists(out):
+        os.remove(out)
+    mesh2d = make_mesh_2d(2, 4)
+    t0 = time.perf_counter()
+    rows = [
+        # 2-D dcn x ici double ring, every scheme, incl. a ragged size
+        ("2d", VarianceConfig(backend="mesh", n_workers=8, n_pos=8192,
+                              n_neg=8192, n_reps=100)),
+        ("2d", VarianceConfig(backend="mesh", n_workers=8, n_pos=8192,
+                              n_neg=8192, n_reps=100, scheme="local")),
+        ("2d", VarianceConfig(backend="mesh", n_workers=8, n_pos=8192,
+                              n_neg=8192, n_reps=100,
+                              scheme="repartitioned", n_rounds=4)),
+        ("2d", VarianceConfig(backend="mesh", n_workers=8, n_pos=8197,
+                              n_neg=8187, n_reps=100)),
+        # kernel-kind matrix on the 1-D mesh: scatter (one-sample,
+        # population E h = dim) and degree-3 triplet (double ring)
+        ("1d", VarianceConfig(kernel="scatter", backend="mesh",
+                              n_workers=8, n_pos=4096, n_neg=4096,
+                              n_reps=100)),
+        ("1d", VarianceConfig(kernel="triplet_indicator", backend="mesh",
+                              n_workers=8, n_pos=96, n_neg=96, dim=3,
+                              n_reps=100)),
+    ]
+    for topo, cfg in rows:
+        runner = make_mesh_mc_runner(
+            cfg, mesh=mesh2d if topo == "2d" else None
+        )
+        assert runner is not None, cfg
+        import jax.numpy as jnp
+
+        ests = np.asarray(runner(jnp.arange(cfg.n_reps)))
+        r = {
+            "config": cfg.to_json(),
+            "mesh": "dcn2 x ici4" if topo == "2d" else "w8",
+            "mean": float(ests.mean()),
+            "variance": float(ests.var(ddof=1)),
+            "std_error": float(ests.std(ddof=1) / np.sqrt(cfg.n_reps)),
+            "vmapped": True,
+            "n_reps": cfg.n_reps,
+        }
+        write_jsonl([r], out)
+        print(json.dumps({
+            "mesh": r["mesh"], "kernel": cfg.kernel,
+            "scheme": cfg.scheme, "n": [cfg.n_pos, cfg.n_neg],
+            "mean": round(r["mean"], 6), "variance": r["variance"],
         }), flush=True)
     print(f"# wrote {out} in {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)

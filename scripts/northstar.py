@@ -209,10 +209,10 @@ def main():
             n_reps=8 if q else 50,
         )
         run(mesh6, "mesh_n1e6.jsonl", chunk=None if q else 4,
-            trace_dir=os.path.join(RESULTS, "trace_mesh_complete"))
+            trace_dir=_out("trace_mesh_complete"))
         run(dataclasses.replace(mesh6, scheme="repartitioned", n_rounds=4),
             "mesh_n1e6.jsonl", chunk=None if q else 4,
-            trace_dir=os.path.join(RESULTS, "trace_mesh_repart"))
+            trace_dir=_out("trace_mesh_repart"))
         run(dataclasses.replace(mesh6, scheme="local"), "mesh_n1e6.jsonl",
             chunk=None if q else 4)
         # HBM high-water of the mesh stage (devices that report it)

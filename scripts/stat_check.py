@@ -91,6 +91,11 @@ def main(out: str | None = None) -> int:
             if (not isinstance(cfg, dict) or not M or M < 8
                     or "scheme" not in cfg or "separation" not in cfg):
                 continue
+            if cfg.get("kernel") != "auc" or cfg.get("dim") != 1:
+                # only the 1-D AUC family has the Φ(sep/√2) population
+                # mean and zeta closed forms; scatter/triplet mesh rows
+                # are validated by their own tests, not this audit
+                continue
             pop = true_gaussian_auc(cfg["separation"])
             z_mean = (r["mean"] - pop) / math.sqrt(r["variance"] / M)
             try:
