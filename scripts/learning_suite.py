@@ -28,6 +28,8 @@ invocations):
       # sim sweeps + 8-virtual-CPU mesh rows (forces the CPU platform)
   python scripts/learning_suite.py --stages chip
       # mesh-of-1 training throughput on the attached TPU chip
+  python scripts/learning_suite.py --stages gauss-chip
+      # platform-independence check: sweep cells re-run on the chip
   python scripts/learning_suite.py --stages trace
       # profiler digest of a training run (repartition-event cost)
 
@@ -179,6 +181,36 @@ def stage_gauss(q, platform):
                 dataset="gaussians", out_name="learning_gauss.jsonl",
                 platform=platform,
             )
+
+
+def stage_gauss_chip(q, platform):
+    """The visible-regime sweep cells re-run ON THE TPU CHIP: jax's
+    threefry PRNG is backend-deterministic, so the same seeds draw the
+    same partitions and the chip rows must reproduce the committed CPU
+    rows to f32 rounding — platform-independence evidence for the
+    whole learning suite (learning_gauss_chip.jsonl)."""
+    from tuplewise_tpu.data import make_gaussian_splits
+    from tuplewise_tpu.models.pairwise_sgd import TrainConfig
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    n = 128 if q else 512
+    n_te = 2000 if q else 20000
+    steps = 40 if q else 500
+    S = 4 if q else 48
+    data = make_gaussian_splits(n, n_te, dim=10, separation=0.8, seed=0)
+    scorer = LinearScorer(dim=10)
+    p0 = scorer.init(0)
+    base = TrainConfig(kernel="hinge", lr=0.3, steps=steps, seed=1000)
+    N = 16 if q else 256
+    for nr in ((1, NEVER) if q else (1, 25, NEVER)):
+        run_config(
+            scorer, p0, data,
+            dataclasses.replace(base, n_workers=N,
+                                repartition_every=nr),
+            n_seeds=S, eval_every=steps // 20 or 1,
+            dataset="gaussians", out_name="learning_gauss_chip.jsonl",
+            platform=platform,
+        )
 
 
 def stage_adult(q, platform):
@@ -402,13 +434,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--stages", default="gauss,adult,mesh8,figs",
-                    help="comma list: gauss,adult,mesh8,chip,trace,figs")
+                    help="comma list: gauss,adult,mesh8,chip,gauss-chip,trace,figs")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
-    known = {"gauss", "adult", "mesh8", "chip", "trace", "figs"}
+    known = {"gauss", "adult", "mesh8", "chip", "gauss-chip", "trace", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}")
-    if stages & {"chip", "trace"} and stages & {"gauss", "adult", "mesh8"}:
+    if stages & {"chip", "gauss-chip", "trace"} and stages & {"gauss", "adult", "mesh8"}:
         ap.error("run --stages chip in its own invocation: the platform "
                  "(TPU vs forced-CPU) is process-global")
     global QUICK
@@ -441,6 +473,8 @@ def main():
         stage_mesh8(args.quick, platform)
     if "chip" in stages:
         stage_chip(args.quick, platform)
+    if "gauss-chip" in stages:
+        stage_gauss_chip(args.quick, platform)
     if "trace" in stages:
         stage_trace(args.quick, platform)
     # data stages completed: atomically publish their rows BEFORE figs

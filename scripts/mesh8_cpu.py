@@ -75,6 +75,7 @@ def main():
 
 def main_2d_and_kernels():
     """2-D hierarchical ring + non-diff kernel kinds, mesh-native MC."""
+    import jax.numpy as jnp
     import numpy as np
 
     from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
@@ -110,8 +111,6 @@ def main_2d_and_kernels():
             cfg, mesh=mesh2d if topo == "2d" else None
         )
         assert runner is not None, cfg
-        import jax.numpy as jnp
-
         ests = np.asarray(runner(jnp.arange(cfg.n_reps)))
         r = {
             "config": cfg.to_json(),

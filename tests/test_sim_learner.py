@@ -176,3 +176,33 @@ def test_learning_figures_render(tmp_path):
         plot_auc_vs_budget(budget, str(tmp_path / "b.png")),
     ):
         assert os.path.getsize(p) > 1000
+
+
+def test_committed_chip_rows_match_cpu_rows():
+    """Regression gate for the platform-independence claim (RESULTS
+    §6): the committed TPU-chip sweep rows must match the committed
+    CPU rows to f32 rounding — threefry is backend-deterministic, so
+    the same seeds draw the same partitions and any larger divergence
+    means a semantics change slipped into one path."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    chip_path = os.path.join(repo, "results", "learning_gauss_chip.jsonl")
+    cpu_path = os.path.join(repo, "results", "learning_gauss.jsonl")
+    if not (os.path.exists(chip_path) and os.path.exists(cpu_path)):
+        pytest.skip("committed learning artifacts absent")
+    chip = [json.loads(line) for line in open(chip_path)]
+    cpu = [json.loads(line) for line in open(cpu_path)]
+    assert chip, "empty chip artifact"
+    for c in chip:
+        match = [r for r in cpu
+                 if r["n_workers"] == c["n_workers"]
+                 and r["n_r"] == c["n_r"]
+                 and r["pairs_per_worker"] == c["pairs_per_worker"]
+                 and r["steps"] == c["steps"] and r["seed0"] == c["seed0"]]
+        assert match, f"no CPU row for chip cell {c['n_workers']}/{c['n_r']}"
+        m = match[0]
+        assert abs(c["final_auc_mean"] - m["final_auc_mean"]) < 5e-5
+        for a, b in zip(c["auc_mean"], m["auc_mean"]):
+            assert abs(a - b) < 1e-4, (c["n_r"], a, b)

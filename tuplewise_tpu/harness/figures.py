@@ -336,8 +336,8 @@ def plot_sd_vs_comm(rows, out_png: str, title: str = "") -> str:
     import matplotlib.pyplot as plt
 
     rows = [r for r in _results(rows) if r.get("final_auc_sd")]
-    if not rows:   # all-n_seeds=1 suites have no spread to plot:
-        return out_png   # skip writing a blank axis-only figure
+    if not rows:   # all-n_seeds=1 suites have no spread to plot: skip
+        return None   # (no file written — callers must null-check)
     fig, ax = plt.subplots(figsize=(5.5, 4))
     by_n = {}
     for r in rows:
