@@ -140,8 +140,11 @@ def run_config(scorer, p0, data, cfg, *, n_seeds, eval_every, dataset,
     return rec
 
 
-def stage_gauss(q, platform):
-    """Gaussians, small-block regime: n_r x N sweep + pair-budget sweep."""
+def _gauss_cells(q):
+    """ONE source of truth for the gaussian sweep's data/config cell:
+    the chip platform-independence stage must reproduce stage_gauss's
+    cells exactly, so both read this (a divergence would surface as a
+    confusing tolerance failure in the chip-vs-CPU regression gate)."""
     from tuplewise_tpu.data import make_gaussian_splits
     from tuplewise_tpu.models.pairwise_sgd import TrainConfig
     from tuplewise_tpu.models.scorers import LinearScorer
@@ -154,6 +157,12 @@ def stage_gauss(q, platform):
     scorer = LinearScorer(dim=10)
     p0 = scorer.init(0)
     base = TrainConfig(kernel="hinge", lr=0.3, steps=steps, seed=1000)
+    return data, scorer, p0, base, S, steps
+
+
+def stage_gauss(q, platform):
+    """Gaussians, small-block regime: n_r x N sweep + pair-budget sweep."""
+    data, scorer, p0, base, S, steps = _gauss_cells(q)
     nrs = (1, 5, NEVER) if q else (1, 5, 25, 125, NEVER)
     for N in ((16, 32) if q else (32, 128, 256, 16)):
         for nr in nrs:

@@ -233,3 +233,31 @@ class TestEndToEndFaultTolerance:
         est = Estimator("auc", backend="numpy", n_workers=4)
         v = run_with_fault_tolerance(est, "local", s1, s2, seed=1)
         assert v == est.local_average(s1, s2, seed=1)
+
+
+class TestFaults2DMesh:
+    def test_drop_renormalize_on_2d_mesh(self):
+        """Drop-and-renormalize works unchanged over the hierarchical
+        (dcn x ici) mesh: the alive mask indexes the LINEARIZED worker
+        id, so a 2-D local average with dropped workers must equal the
+        1-D mesh's value at the same seed (identical fold chains)."""
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        from tuplewise_tpu.parallel.mesh import make_mesh_2d
+
+        X, Y = make_gaussians(512, 512, dim=1, separation=1.0, seed=3)
+        s1, s2 = X[:, 0], Y[:, 0]
+        flat = Estimator("auc", backend="mesh", n_workers=8,
+                         tile_a=64, tile_b=64)
+        hier = Estimator("auc", backend="mesh", mesh=make_mesh_2d(2, 4),
+                         tile_a=64, tile_b=64)
+        for dropped in ((), (3,), (0, 6)):
+            a = flat.local_average(s1, s2, seed=5, dropped_workers=dropped)
+            b = hier.local_average(s1, s2, seed=5, dropped_workers=dropped)
+            assert abs(a - b) < 1e-6, dropped
+        # dropping changes the value (the mask is live on 2-D too)
+        a0 = hier.local_average(s1, s2, seed=5)
+        a1 = hier.local_average(s1, s2, seed=5, dropped_workers=(2,))
+        assert a0 != a1

@@ -203,6 +203,13 @@ def test_committed_chip_rows_match_cpu_rows():
                  and r["steps"] == c["steps"] and r["seed0"] == c["seed0"]]
         assert match, f"no CPU row for chip cell {c['n_workers']}/{c['n_r']}"
         m = match[0]
+        # the gate is vacuous unless the rows really came from two
+        # different platforms (a chip-stage rerun on a TPU-less host
+        # would stamp cpu and compare cpu-to-cpu)
+        assert c["platform"] == "tpu", c["platform"]
+        assert m["platform"] == "cpu", m["platform"]
+        # identical eval grids, else zip compares different steps
+        assert c["eval_steps"] == m["eval_steps"]
         assert abs(c["final_auc_mean"] - m["final_auc_mean"]) < 5e-5
         for a, b in zip(c["auc_mean"], m["auc_mean"]):
             assert abs(a - b) < 1e-4, (c["n_r"], a, b)

@@ -321,7 +321,8 @@ def plot_auc_vs_budget(rows, out_png: str, title: str = "") -> str:
     return out_png
 
 
-def plot_sd_vs_comm(rows, out_png: str, title: str = "") -> str:
+def plot_sd_vs_comm(rows, out_png: str,
+                    title: str = "") -> Optional[str]:
     """Across-seed SD of the final model vs communication events — the
     learning analogue of the estimator's variance-vs-T decay (RESULTS
     §6.1 finding 2). No closed-form guide is drawn: unlike the
