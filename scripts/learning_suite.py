@@ -198,18 +198,15 @@ def stage_gauss_chip(q, platform):
     same partitions and the chip rows must reproduce the committed CPU
     rows to f32 rounding — platform-independence evidence for the
     whole learning suite (learning_gauss_chip.jsonl)."""
-    from tuplewise_tpu.data import make_gaussian_splits
-    from tuplewise_tpu.models.pairwise_sgd import TrainConfig
-    from tuplewise_tpu.models.scorers import LinearScorer
+    import jax
 
-    n = 128 if q else 512
-    n_te = 2000 if q else 20000
-    steps = 40 if q else 500
-    S = 4 if q else 48
-    data = make_gaussian_splits(n, n_te, dim=10, separation=0.8, seed=0)
-    scorer = LinearScorer(dim=10)
-    p0 = scorer.init(0)
-    base = TrainConfig(kernel="hinge", lr=0.3, steps=steps, seed=1000)
+    if jax.devices()[0].platform != "tpu":
+        raise SystemExit(
+            "gauss-chip must run on the TPU chip: platform is "
+            f"{jax.devices()[0].platform!r} — rows stamped from a "
+            "TPU-less host would make the chip-vs-CPU gate vacuous"
+        )
+    data, scorer, p0, base, S, steps = _gauss_cells(q)
     N = 16 if q else 256
     for nr in ((1, NEVER) if q else (1, 25, NEVER)):
         run_config(

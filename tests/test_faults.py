@@ -236,15 +236,12 @@ class TestEndToEndFaultTolerance:
 
 
 class TestFaults2DMesh:
+    @needs_mesh
     def test_drop_renormalize_on_2d_mesh(self):
         """Drop-and-renormalize works unchanged over the hierarchical
         (dcn x ici) mesh: the alive mask indexes the LINEARIZED worker
         id, so a 2-D local average with dropped workers must equal the
         1-D mesh's value at the same seed (identical fold chains)."""
-        import jax
-
-        if jax.device_count() < 8:
-            pytest.skip("needs 8 virtual devices")
         from tuplewise_tpu.parallel.mesh import make_mesh_2d
 
         X, Y = make_gaussians(512, 512, dim=1, separation=1.0, seed=3)

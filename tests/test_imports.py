@@ -39,3 +39,21 @@ def test_numpy_path_does_not_import_jax():
         [sys.executable, "-c", _CODE], capture_output=True, text=True
     )
     assert proc.returncode == 0 and "OK" in proc.stdout, proc.stderr
+
+
+def test_results_io_quick_rule():
+    """The shared quick-sibling rule round-trips (three suites rely on
+    it agreeing with itself)."""
+    from tuplewise_tpu.utils.results_io import (
+        is_quick, quick_sibling, strip_quick,
+    )
+
+    assert quick_sibling("a.jsonl", False) == "a.jsonl"
+    assert quick_sibling("a.jsonl", True) == "a_quick.jsonl"
+    assert quick_sibling("trace_dir", True) == "trace_dir_quick"
+    assert strip_quick("a_quick.jsonl") == "a.jsonl"
+    assert strip_quick("a.jsonl") == "a.jsonl"
+    assert is_quick("a_quick.jsonl") and not is_quick("a.jsonl")
+    # round trip: sibling of a base name strips back to itself
+    for name in ("x.jsonl", "tradeoff_rounds_N125000.jsonl", "d"):
+        assert strip_quick(quick_sibling(name, True)) == name
