@@ -228,7 +228,7 @@ def stage_adult(q, platform):
 
     n = 600 if q else 8000
     steps = 40 if q else 400
-    S = 4 if q else 24
+    S = 4 if q else 48
     X, y, Xte, yte, meta = load_adult_splits(n=n, seed=0)
     Xp, Xn = split_by_label(X, y)
     Xp_te, Xn_te = split_by_label(Xte, yte)
