@@ -320,13 +320,27 @@ def main():
                     continue
                 if str(rec.get("config")) not in wanted:
                     keep.append(line)
-    with open(path, "w") as out:
-        out.writelines(keep)
-        for key in sorted(wanted):
-            try:
-                fns[key](out, args.quick)
-            except Exception as e:  # keep the suite going; record why
-                emit({"config": key, "error": repr(e)}, out)
+    # Atomic publish with per-config durability: each config's rows
+    # collect in memory, then keep + everything-finished-so-far rewrites
+    # a .partial sibling and os.replace()s onto the real file AFTER EVERY
+    # config — a crash (even SIGKILL, which a try/except can't catch)
+    # mid-config loses only that config's rows, never the kept rows or
+    # earlier configs' hours of results.
+    import io
+
+    done_rows = []
+    partial = path + ".partial"
+    for key in sorted(wanted):
+        buf = io.StringIO()
+        try:
+            fns[key](buf, args.quick)
+        except Exception as e:  # keep the suite going; record why
+            emit({"config": key, "error": repr(e)}, buf)
+        done_rows.append(buf.getvalue())
+        with open(partial, "w") as out:
+            out.writelines(keep)
+            out.writelines(done_rows)
+        os.replace(partial, path)
     log(f"wrote {path}")
 
 

@@ -26,8 +26,32 @@ from tuplewise_tpu.backends.numpy_backend import NumpyBackend
 from tuplewise_tpu.ops.kernels import Kernel
 
 _DIFF_IDS = {"auc": 0, "hinge": 1, "logistic": 2}
-# (native kernel id, margin) — mirrors ops/kernels.py triplet defaults
-_TRIPLET_IDS = {"triplet_indicator": (0, 0.0), "triplet_hinge": (1, 1.0)}
+
+
+def _native_triplet_spec(kernel: Kernel):
+    """(native id, margin) for the C++ triplet engine, or None for the
+    inherited NumPy path. Dispatch is by triplet_fn IDENTITY against the
+    built-in kernels (the same discipline as jax_backend's `k is
+    auc_kernel` check) — a name-colliding custom kernel with a different
+    body must NOT be routed to the built-in C++ formula. The margin is
+    then read off the function's own default — the single source of
+    truth in ops/kernels.py; a second literal here would silently
+    diverge the native path if the Python default ever changed."""
+    import inspect
+
+    from tuplewise_tpu.ops.kernels import (
+        triplet_hinge_kernel, triplet_indicator_kernel,
+    )
+
+    ids = {
+        triplet_indicator_kernel.triplet_fn: 0,
+        triplet_hinge_kernel.triplet_fn: 1,
+    }
+    kid = ids.get(kernel.triplet_fn)
+    if kid is None:
+        return None
+    margin = inspect.signature(kernel.triplet_fn).parameters["margin"].default
+    return kid, float(margin)
 
 
 def _i64p(x: Optional[np.ndarray]):
@@ -56,6 +80,9 @@ class CppBackend(NumpyBackend):
                 "native pair library unavailable (no working g++?); "
                 "use backend='numpy' instead"
             )
+        # resolved once here so a kernel the native engine can't serve
+        # surfaces (as a NumPy fallback) at construction, not mid-estimate
+        self._triplet_spec = _native_triplet_spec(self.kernel)
 
     # The ONLY override: the innermost (sum, count) pair reduction.
     def _pair_stats(
@@ -101,9 +128,9 @@ class CppBackend(NumpyBackend):
         Y: np.ndarray,
         ids_x: Optional[np.ndarray] = None,
     ) -> Tuple[float, int]:
-        spec = _TRIPLET_IDS.get(self.kernel.name)
-        if spec is None:  # custom triplet kernels: NumPy path
+        if self._triplet_spec is None:  # custom triplet kernels: NumPy path
             return super()._triplet_stats(X, Y, ids_x)
+        kid, margin = self._triplet_spec
         x = np.ascontiguousarray(np.atleast_2d(X), np.float64)
         y = np.ascontiguousarray(np.atleast_2d(Y), np.float64)
         ids = np.ascontiguousarray(
@@ -112,7 +139,7 @@ class CppBackend(NumpyBackend):
         out_sum = ctypes.c_double()
         out_count = ctypes.c_int64()
         self._lib.triplet_stats_native(
-            spec[0], ctypes.c_double(spec[1]),
+            kid, ctypes.c_double(margin),
             _dp(x), x.shape[0], _dp(y), y.shape[0], x.shape[1],
             _i64p(ids), ctypes.byref(out_sum), ctypes.byref(out_count),
         )
