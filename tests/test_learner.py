@@ -141,6 +141,56 @@ class TestAnalyticPairGradient:
         np.testing.assert_allclose(g1d, g1s, atol=1e-7)
         np.testing.assert_allclose(g2d, g2s, atol=1e-7)
 
+    @pytest.mark.parametrize("kname", ["hinge", "logistic"])
+    def test_pallas_grad_kernel_parity(self, kname):
+        """The one-pass Pallas grad kernel (interpret mode) must match
+        the XLA streamed pair_grad_sums on ragged sizes [VERDICT r3
+        next #2]."""
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops.kernels import get_kernel
+        from tuplewise_tpu.ops.pair_tiles import pair_grad_sums
+        from tuplewise_tpu.ops.pallas_pairs import pallas_pair_grad_sums
+
+        k = get_kernel(kname)
+        rng = np.random.default_rng(7)
+        for n1, n2 in [(70, 90), (256, 512), (300, 517)]:
+            s1 = jnp.asarray(rng.standard_normal(n1), jnp.float32)
+            s2 = jnp.asarray(rng.standard_normal(n2), jnp.float32)
+            rp, cp = pallas_pair_grad_sums(
+                s1, s2, kernel=k, tile_a=256, tile_b=256, interpret=True
+            )
+            rx, cx = pair_grad_sums(k, s1, s2, tile_a=64, tile_b=64)
+            np.testing.assert_allclose(rp, rx, rtol=2e-5, atol=1e-5)
+            np.testing.assert_allclose(cp, cx, rtol=2e-5, atol=1e-5)
+
+    def test_dispatch_env_override_routes_to_pallas(self, monkeypatch):
+        """TUPLEWISE_HARNESS_PALLAS=interpret forces the Pallas grad
+        branch of _grad_sums_dispatch on CPU; diff_pair_mean's VJP must
+        still match dense autodiff through it end-to-end."""
+        import jax
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pair_tiles
+        from tuplewise_tpu.ops.kernels import get_kernel
+
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "interpret")
+        k = get_kernel("logistic")
+        rng = np.random.default_rng(11)
+        s1 = jnp.asarray(rng.standard_normal(130), jnp.float32)
+        s2 = jnp.asarray(rng.standard_normal(70), jnp.float32)
+
+        def dense(a, b):
+            return jnp.mean(k.diff(a[:, None] - b[None, :], jnp))
+
+        g1d, g2d = jax.grad(dense, argnums=(0, 1))(s1, s2)
+        g1p, g2p = jax.grad(
+            lambda a, b: pair_tiles.diff_pair_mean(k, a, b, 32, 32),
+            argnums=(0, 1),
+        )(s1, s2)
+        np.testing.assert_allclose(g1d, g1p, atol=1e-7)
+        np.testing.assert_allclose(g2d, g2p, atol=1e-7)
+
     def test_learner_uses_it_and_still_learns(self):
         """End-to-end: hinge training (analytic path) still lifts AUC."""
         from tuplewise_tpu.data import make_gaussians

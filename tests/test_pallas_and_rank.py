@@ -59,6 +59,59 @@ class TestPallasKernel:
         with pytest.raises(ValueError, match="impl"):
             Estimator("hinge", backend="jax", impl="cuda")
 
+    def test_any_size_decomposition_parity(self, scores):
+        """pallas_pair_sum_any (unmasked interior + masked edge strips)
+        must match the XLA tile reduction at ARBITRARY sizes — the
+        n=10^7 headline path where n % 128 != 0 [VERDICT r3 next #1].
+        Shapes cover: both ragged, divisible (pure interior), thinner
+        than one tile each way (no interior), and single-row."""
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pair_tiles
+        from tuplewise_tpu.ops.kernels import get_kernel
+        from tuplewise_tpu.ops.pallas_pairs import pallas_pair_sum_any
+
+        s1, s2 = scores
+        a_all = jnp.asarray(s1, jnp.float32)
+        b_all = jnp.asarray(s2, jnp.float32)
+        shapes = [(2048, 1024), (2047, 1023), (2048, 1000), (130, 1024),
+                  (100, 70), (1, 513)]
+        for name in ("auc", "hinge", "logistic"):
+            k = get_kernel(name)
+            for n1, n2 in shapes:
+                a, b = a_all[:n1], b_all[:n2]
+                sp = float(pallas_pair_sum_any(
+                    a, b, kernel=k, tile_a=256, tile_b=512, interpret=True,
+                ))
+                sx = float(pair_tiles.pair_stats(
+                    k, a, b, tile_a=256, tile_b=512)[0])
+                assert abs(sp - sx) / max(abs(sx), 1) < 1e-6, (name, n1, n2)
+
+    def test_any_size_vmaps(self, scores):
+        """The harness local path vmaps the hot loop over worker blocks;
+        the decomposed kernel must batch correctly."""
+        import jax
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pair_tiles
+        from tuplewise_tpu.ops.kernels import auc_kernel
+        from tuplewise_tpu.ops.pallas_pairs import pallas_pair_sum_any
+
+        s1, s2 = scores
+        b1 = jnp.asarray(s1[:1200], jnp.float32).reshape(4, 300)
+        b2 = jnp.asarray(s2[:1000], jnp.float32).reshape(4, 250)
+        got = jax.vmap(lambda a, b: pallas_pair_sum_any(
+            a, b, kernel=auc_kernel, tile_a=128, tile_b=128,
+            interpret=True,
+        ))(b1, b2)
+        want = jnp.stack([
+            pair_tiles.pair_stats(
+                auc_kernel, b1[i], b2[i], tile_a=128, tile_b=128)[0]
+            for i in range(4)
+        ])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
     def test_masked_parity_with_xla(self, scores):
         """The mask-aware kernel (the ring hot loop) must match the XLA
         tile reduction on ragged, partially-masked inputs — including

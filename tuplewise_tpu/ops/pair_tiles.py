@@ -375,11 +375,34 @@ def _diff_pair_mean_fwd(kernel, s1, s2, tile_a, tile_b):
     return diff_pair_mean(kernel, s1, s2, tile_a, tile_b), (s1, s2)
 
 
+def _grad_sums_dispatch(kernel, s1, s2, tile_a, tile_b):
+    """Best gradient path for this platform: the one-pass Pallas grad
+    kernel on TPU (ops.pallas_pairs.pallas_pair_grad_sums — forward-rate
+    row/col g' sums [VERDICT r3 next #2]), the XLA scan otherwise. The
+    Pallas col accumulator holds the padded b side resident in VMEM, so
+    huge n2 stays on XLA (trainer blocks are far below the bound).
+    TUPLEWISE_HARNESS_PALLAS=interpret|off overrides, as in the harness
+    hot loops."""
+    import jax
+
+    from tuplewise_tpu.ops.pallas_pairs import resolve_pallas_mode
+
+    use_pallas, interpret = resolve_pallas_mode(
+        jax.devices()[0].platform
+    )
+    if use_pallas and s2.shape[0] <= 1_000_000:  # ~4 MB VMEM col bound
+        from tuplewise_tpu.ops.pallas_pairs import pallas_pair_grad_sums
+
+        row, col = pallas_pair_grad_sums(
+            s1, s2, kernel=kernel, interpret=interpret
+        )
+        return row.astype(s1.dtype), col.astype(s2.dtype)
+    return pair_grad_sums(kernel, s1, s2, tile_a=tile_a, tile_b=tile_b)
+
+
 def _diff_pair_mean_bwd(kernel, tile_a, tile_b, res, ct):
     s1, s2 = res
-    row, col = pair_grad_sums(
-        kernel, s1, s2, tile_a=tile_a, tile_b=tile_b
-    )
+    row, col = _grad_sums_dispatch(kernel, s1, s2, tile_a, tile_b)
     # python float, not int: the pair count can exceed int32 inside jit
     inv = ct / float(s1.shape[0] * s2.shape[0])
     # d/ds1_i = +mean_j g'; d/ds2_j carries the -1 from d = s1 - s2
