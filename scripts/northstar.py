@@ -85,17 +85,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--stages", type=str,
-                    default="1e6,1e7,tradeoff,mesh,exact,scale8,figs",
+                    default="1e6,1e7,tradeoff,designs,mesh,exact,scale8,"
+                            "figs",
                     help="comma list of stages to run (the default runs "
                          "everything RESULTS.md commits: the production "
-                         "scales, the visible-trade-off regime, the mesh "
-                         "ring, the exact rank-AUC series, and the "
-                         "n=10^8 scale demo)")
+                         "scales, the visible-trade-off regime, the "
+                         "sampling-design rows, the mesh ring, the exact "
+                         "rank-AUC series, and the n=10^8 scale demo)")
     args = ap.parse_args()
     global QUICK
     QUICK = args.quick
     stages = set(args.stages.split(","))
-    known = {"1e6", "1e7", "tradeoff", "mesh", "exact", "scale8", "figs"}
+    known = {"1e6", "1e7", "tradeoff", "designs", "mesh", "exact",
+             "scale8", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}; "
                  f"choose from {sorted(known)}")
@@ -193,6 +195,43 @@ def main():
         with open(_out("tradeoff_theory.json"), "w") as f:
             json.dump(theory, f, indent=1)
         log("tradeoff stage done (theory overlay written)")
+
+    if "designs" in stages:
+        # Sampling designs MEASURED, not just implemented [VERDICT r3
+        # next #4]. Headline scale first: B << G = n1*n2, so the
+        # finite-population factor is ~1 and swor/bernoulli are
+        # variance-NEUTRAL vs swr — the committed rows pin that
+        # prediction (each z-checks against its own fpc closed form,
+        # scripts/stat_check.py).
+        log("== stage sampling designs (swor/bernoulli, measured) ==")
+        for design in ("swor", "bernoulli"):
+            for B in (1_000, 10_000, 100_000):
+                if q and B > 10_000:
+                    continue
+                run(dataclasses.replace(
+                        base6, scheme="incomplete", n_pairs=B,
+                        design=design),
+                    "designs_n1e6.jsonl", chunk=None if q else 25)
+        # Where the reduction LIVES: conditional on a frozen dataset
+        # (fix_data=True), Monte-Carlo over sampling randomness only.
+        # The audit's closed forms are then EXACT (s^2 = U(1-U), no
+        # plug-in): swor at B = G/2 halves the swr conditional
+        # variance; at B = G/10 it removes 10%. Only B/G matters for
+        # the factor, so n=500/class (G=250k) keeps the host-designed
+        # index blocks small; chunking bounds them at [250, B].
+        mC = 8 if q else 2_000
+        baseC = VarianceConfig(
+            n_pos=500, n_neg=500, separation=1.0, n_workers=2,
+            n_reps=mC, fix_data=True,
+        )
+        for design in ("swr", "swor", "bernoulli"):
+            for B in (25_000, 125_000):
+                if q and B > 25_000:
+                    continue
+                run(dataclasses.replace(
+                        baseC, scheme="incomplete", n_pairs=B,
+                        design=design),
+                    "designs_conditional.jsonl", chunk=None if q else 250)
 
     if "mesh" in stages:
         # the DISTRIBUTED estimator on the real chip: mesh of 1, ring

@@ -30,6 +30,7 @@ sys.path.insert(0, REPO)
 
 from tuplewise_tpu.data import make_gaussians, true_gaussian_auc  # noqa: E402
 from tuplewise_tpu.estimators.variance import (  # noqa: E402
+    conditional_incomplete_variance,
     incomplete_variance_from_zetas,
     local_variance_from_zetas,
     repartitioned_variance_from_zetas,
@@ -39,6 +40,34 @@ from tuplewise_tpu.estimators.variance import (  # noqa: E402
 
 Z_LIMIT = 4.0
 _ZETAS = {}
+_FIXED = {}
+
+
+def fixed_row_targets(cfg: dict):
+    """(exact mean, exact conditional variance) for a fix_data=True
+    incomplete row: the frozen dataset is reconstructed bit-identically
+    (harness.variance.fixed_dataset), the complete U computed exactly
+    (O(n log n) midranks), and the conditional design form follows from
+    s^2 = U(1-U) — NO plug-in anywhere, the strongest audit in this
+    file. Returns None when the row isn't auditable this way."""
+    if (cfg.get("scheme") != "incomplete" or cfg.get("backend") != "jax"
+            or cfg.get("kernel") != "auc" or cfg.get("dim") != 1):
+        return None
+    key = (cfg["seed"], cfg["n_pos"], cfg["n_neg"], cfg["separation"])
+    if key not in _FIXED:
+        from tuplewise_tpu.harness.variance import (
+            VarianceConfig, fixed_dataset,
+        )
+        from tuplewise_tpu.models.metrics import auc_score
+
+        s1, s2 = fixed_dataset(VarianceConfig(**cfg))
+        _FIXED[key] = auc_score(s1, s2)
+    u = _FIXED[key]
+    pred = conditional_incomplete_variance(
+        u * (1.0 - u), cfg["n_pos"] * cfg["n_neg"],
+        n_pairs=cfg["n_pairs"], design=cfg.get("design", "swr"),
+    )
+    return u, pred
 
 
 def zetas(kernel: str, separation: float):
@@ -66,7 +95,8 @@ def predicted_variance(cfg: dict) -> float | None:
         )
     if cfg["scheme"] == "incomplete":
         return incomplete_variance_from_zetas(
-            z, n1, n2, n_pairs=cfg["n_pairs"]
+            z, n1, n2, n_pairs=cfg["n_pairs"],
+            design=cfg.get("design", "swr"),
         )
     return None
 
@@ -96,15 +126,22 @@ def main(out: str | None = None) -> int:
                 # mean and zeta closed forms; scatter/triplet mesh rows
                 # are validated by their own tests, not this audit
                 continue
-            pop = true_gaussian_auc(cfg["separation"])
+            if cfg.get("fix_data"):
+                targets = fixed_row_targets(cfg)
+                if targets is None:
+                    continue  # conditional rows outside the exact audit
+                pop, pred = targets
+            else:
+                pop = true_gaussian_auc(cfg["separation"])
+                try:
+                    pred = predicted_variance(cfg)
+                except (ValueError, ZeroDivisionError):
+                    # legal harness rows the closed forms reject (e.g.
+                    # per-worker class size < 2 for the zeta formulas):
+                    # audit the mean, skip the variance z-score
+                    # (ADVICE r2)
+                    pred = None
             z_mean = (r["mean"] - pop) / math.sqrt(r["variance"] / M)
-            try:
-                pred = predicted_variance(cfg)
-            except (ValueError, ZeroDivisionError):
-                # legal harness rows the closed forms reject (e.g.
-                # per-worker class size < 2 for the zeta formulas):
-                # audit the mean, skip the variance z-score (ADVICE r2)
-                pred = None
             # `is not None`, never truthiness: a pred of exactly 0.0 is
             # a real closed form (zero-variance limit), only the
             # z-score is undefined for it
@@ -119,7 +156,9 @@ def main(out: str | None = None) -> int:
             rows.append(
                 f"{name:<28} {cfg['scheme']:>13} N={cfg['n_workers']:<7}"
                 f"T={cfg['n_rounds']:<3} B={cfg['n_pairs']:<9}"
-                f"n={cfg['n_pos']:<8} M={M:<4}"
+                f"d={cfg.get('design', 'swr'):<9}"
+                + ("[cond]" if cfg.get("fix_data") else "      ")
+                + f"n={cfg['n_pos']:<8} M={M:<4}"
                 f" mean={r['mean']:.6f} z_mean={z_mean:+5.2f}"
                 + (f" var={r['variance']:.3e} pred={pred:.3e}"
                    f" z_var={z_var:+5.2f}" if has_pred

@@ -158,6 +158,81 @@ class TestVarianceExperiment:
         assert abs(r["mean"] - true_gaussian_auc(1.0)) < 0.05
 
 
+class TestDesignedIncompleteHarness:
+    """swor/bernoulli designs MEASURED through the MC harness
+    [VERDICT r3 next #4]. Unconditionally the design difference is
+    sigma_h^2/G — invisible against Var(U_n); the measurement that
+    resolves it is CONDITIONAL on a frozen dataset (fix_data=True),
+    where the closed forms are exact: s^2 = U(1-U) for the indicator
+    kernel, and swor at B = G/2 halves the swr variance."""
+
+    @staticmethod
+    def _conditional(design, n_reps=1_500):
+        cfg = VarianceConfig(
+            n_pos=100, n_neg=100, separation=0.25, scheme="incomplete",
+            n_pairs=5_000, design=design, n_reps=n_reps, n_workers=2,
+            fix_data=True,
+        )
+        return cfg, run_variance_experiment(cfg)
+
+    @staticmethod
+    def _exact_targets(cfg):
+        from tuplewise_tpu.estimators.variance import (
+            conditional_incomplete_variance,
+        )
+        from tuplewise_tpu.harness.variance import fixed_dataset
+        from tuplewise_tpu.models.metrics import auc_score
+
+        s1, s2 = fixed_dataset(cfg)
+        u = auc_score(s1, s2)
+        pred = conditional_incomplete_variance(
+            u * (1 - u), cfg.n_pos * cfg.n_neg,
+            n_pairs=cfg.n_pairs, design=cfg.design,
+        )
+        return u, pred
+
+    def test_swor_halves_conditional_variance_vs_swr(self):
+        # B = G/2 here: fpc = 1/2 exactly (up to G/(G-1))
+        cfg_r, r_swr = self._conditional("swr")
+        cfg_o, r_swor = self._conditional("swor")
+        assert r_swr["vmapped"] and r_swor["vmapped"]
+        u, pred_swr = self._exact_targets(cfg_r)
+        _, pred_swor = self._exact_targets(cfg_o)
+        assert pred_swor == pytest.approx(pred_swr / 2, rel=1e-3)
+        # SE(var)/var ~ sqrt(2/M) = 3.7% at M=1500; 4-sigma bounds
+        assert abs(r_swr["variance"] - pred_swr) / pred_swr < 0.15
+        assert abs(r_swor["variance"] - pred_swor) / pred_swor < 0.15
+        # the factor-2 reduction as a direct measurement
+        ratio = r_swor["variance"] / r_swr["variance"]
+        assert 0.35 < ratio < 0.65, ratio
+        # conditional means are unbiased for the FIXED-data complete U
+        for r in (r_swr, r_swor):
+            assert abs(r["mean"] - u) < 5 * r["std_error"]
+
+    def test_bernoulli_conditional_matches_swor_form(self):
+        cfg, r = self._conditional("bernoulli", n_reps=1_000)
+        assert r["vmapped"]
+        u, pred = self._exact_targets(cfg)
+        assert abs(r["variance"] - pred) / pred < 0.2
+        assert abs(r["mean"] - u) < 5 * r["std_error"]
+
+    def test_designed_closed_form_hits_complete_floor_at_full_grid(self):
+        from tuplewise_tpu.estimators.variance import (
+            incomplete_variance_from_zetas,
+            two_sample_variance_from_zetas,
+            two_sample_zetas,
+        )
+
+        X, Y = make_gaussians(40_000, 40_000, 1, 1.0, seed=77)
+        z = two_sample_zetas("auc", X[:, 0], Y[:, 0])
+        full = incomplete_variance_from_zetas(
+            z, 64, 64, n_pairs=64 * 64, design="swor"
+        )
+        assert full == pytest.approx(
+            two_sample_variance_from_zetas(z, 64, 64), rel=1e-12
+        )
+
+
 class TestTradeoffs:
     def test_variance_decreases_with_rounds(self):
         cfg = VarianceConfig(n_pos=128, n_neg=128, n_workers=8, n_reps=200)
@@ -316,6 +391,52 @@ class TestMeshMC:
         r = run_variance_experiment(cfg)
         assert r["vmapped"], "scatter mesh config fell back to host loop"
         assert abs(r["mean"] - 1.0) < 5 * r["std_error"] + 0.02
+
+    @pytest.mark.parametrize("design", ["swor", "bernoulli"])
+    def test_designed_incomplete_on_mesh(self, design):
+        """Host-designed distinct tuple sets run mesh-native per rep
+        (sharded [N, per] index blocks, cross-shard regather, psum'd
+        weighted mean) [VERDICT r3 next #4]."""
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            backend="mesh", scheme="incomplete", n_pos=96, n_neg=96,
+            n_workers=8, n_pairs=1_000, design=design, n_reps=400,
+        )
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"], "designed mesh config fell back to host loop"
+        assert abs(r["mean"] - r["population_value"]) < 5 * r["std_error"]
+
+    def test_designed_one_sample_on_mesh(self):
+        """One-sample designed sets (scatter, off-diagonal encoding)
+        stay mesh-native; mean matches E||X-X'||^2 / 2 = dim = 1."""
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            kernel="scatter", backend="mesh", scheme="incomplete",
+            n_pos=96, n_neg=96, n_workers=8, n_pairs=800,
+            design="swor", n_reps=64,
+        )
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"]
+        assert abs(r["mean"] - 1.0) < 5 * r["std_error"] + 0.02
+
+    def test_designed_triplet_on_mesh(self):
+        """Degree-3 designed sets (swor) run mesh-native; the mean must
+        agree with the numpy oracle's complete value on a
+        same-distribution draw within MC error."""
+        self._needs_mesh()
+        cfg = VarianceConfig(
+            kernel="triplet_indicator", dim=3, n_pos=64, n_neg=48,
+            n_workers=8, backend="mesh", scheme="incomplete",
+            n_pairs=600, design="swor", n_reps=64,
+        )
+        r = run_variance_experiment(cfg)
+        assert r["vmapped"]
+        from tuplewise_tpu.data import make_gaussians as mg
+        from tuplewise_tpu.estimators.estimator import Estimator
+
+        X, Y = mg(64, 48, 3, 1.0, seed=123)
+        ref = Estimator("triplet_indicator", backend="numpy").complete(X, Y)
+        assert abs(r["mean"] - ref) < 5 * r["std_error"] + 0.05
 
     def test_scatter_matches_host_loop_distribution(self):
         """Mesh-native scatter draws from the same distribution as the

@@ -23,10 +23,15 @@ def scorer():
 
 
 class TestMeshParity:
-    @pytest.mark.parametrize("kernel,ppw", [
-        ("hinge", None), ("logistic", None), ("hinge", 16),
+    @pytest.mark.parametrize("kernel,ppw,design", [
+        ("hinge", None, "swr"), ("logistic", None, "swr"),
+        ("hinge", 16, "swr"),
+        # the on-device distinct designs [VERDICT r3 next #6] share the
+        # exact fold chain and sampler between both trainers too
+        ("hinge", 16, "swor"), ("logistic", 16, "bernoulli"),
     ])
-    def test_matches_mesh_trainer(self, data, scorer, kernel, ppw):
+    def test_matches_mesh_trainer(self, data, scorer, kernel, ppw,
+                                  design):
         """Same TrainConfig + seed -> same trajectory as the shard_map
         trainer on the 8-device mesh (full-pair losses agree to float
         tolerance; sampled-pair paths share the exact fold chain and
@@ -35,7 +40,7 @@ class TestMeshParity:
         p0 = scorer.init(0)
         cfg = TrainConfig(kernel=kernel, lr=0.3, steps=10, n_workers=8,
                           repartition_every=4, pairs_per_worker=ppw,
-                          seed=3)
+                          pair_design=design, seed=3)
         mesh_params, mesh_hist = train_pairwise(scorer, p0, Xp, Xn, cfg)
         out = train_curves(
             scorer, p0, Xp, Xn, Xp[:64], Xn[:64], cfg,

@@ -157,10 +157,64 @@ def repartitioned_variance_from_zetas(
     return vc + max(v_loc - vc, 0.0) / n_rounds
 
 
-def incomplete_variance_from_zetas(zetas, n1, n2, *, n_pairs: int) -> float:
-    """Zeta-level Var(U~_B): Var(U_n) + (zeta_11 - Var(U_n)) / B."""
+def incomplete_variance_from_zetas(
+    zetas, n1, n2, *, n_pairs: int, design: str = "swr"
+) -> float:
+    """Zeta-level Var(U~_B) by sampling design [SURVEY §1.1 incomplete;
+    VERDICT r3 next #4].
+
+    swr (with replacement): Var(U_n) + (zeta_11 - Var(U_n)) / B — the
+    conditional-on-data sampling noise is s^2/B with E[s^2] =
+    zeta_11 - Var(U_n) (total kernel variance minus the part the
+    complete U already carries).
+
+    swor (B DISTINCT tuples): simple random sampling without
+    replacement from the G = n1*n2 grid multiplies the conditional
+    term by the finite-population factor; with S^2 the (G-1)-ddof grid
+    variance, Var(mean) = (S^2/B)(1 - B/G) and E[S^2] =
+    (G/(G-1)) E[s^2], giving
+        Var = Var(U_n) + (zeta_11 - Var(U_n)) * (G - B) / (B (G - 1)).
+    At B = G this hits the complete floor exactly — the variance
+    reduction the distinct designs exist for.
+
+    bernoulli: realized size K ~ Binomial(G, B/G) then a uniform
+    distinct K-set (parallel.partition.draw_pair_design); E over K of
+    the swor form is the swor value up to O(1/B) relative corrections
+    (CV^2 of K), far below the audit's z resolution.
+    """
     vc = two_sample_variance_from_zetas(zetas, n1, n2)
-    return vc + (zetas[-1] - vc) / n_pairs
+    if design == "swr":
+        return vc + (zetas[-1] - vc) / n_pairs
+    if design in ("swor", "bernoulli"):
+        grid = n1 * n2
+        fpc = (grid - n_pairs) / (n_pairs * (grid - 1.0))
+        return vc + (zetas[-1] - vc) * fpc
+    raise ValueError(f"unknown sampling design {design!r}")
+
+
+def conditional_incomplete_variance(
+    grid_var: float, grid: int, *, n_pairs: int, design: str = "swr"
+) -> float:
+    """EXACT Var(U~_B | data) from the grid variance of the kernel
+    values on a FIXED dataset (for the AUC indicator kernel,
+    grid_var = U(1-U) with U the complete statistic — no plug-in).
+
+    This is where the design choice lives [VERDICT r3 next #4]:
+      swr        s^2 / B                     (s^2 = ddof-0 grid var)
+      swor       (S^2/B)(1 - B/G),  S^2 = s^2 G/(G-1) — at B = G/2 the
+                 conditional variance HALVES vs swr; at B = G it is 0
+      bernoulli  E_K[swor(K)] over K ~ Binomial(G, B/G) — equals the
+                 swor value up to O(1/B) relative corrections
+    Unconditionally the difference is sigma_h^2/G, invisible against
+    Var(U_n) ~ zeta_1/n; harness fix_data=True rows measure exactly
+    this conditional quantity.
+    """
+    if design == "swr":
+        return grid_var / n_pairs
+    if design in ("swor", "bernoulli"):
+        big_s2 = grid_var * grid / (grid - 1.0)
+        return (big_s2 / n_pairs) * (1.0 - n_pairs / grid)
+    raise ValueError(f"unknown sampling design {design!r}")
 
 
 def incomplete_variance(kernel, A, B=None, *, n_pairs: int) -> float:

@@ -142,6 +142,105 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
         check_vma=False,
     )
 
+    def _data_key(rep_key):
+        """Fresh draw per rep, or the frozen fix_data key (conditional
+        Monte-Carlo over sampling randomness only)."""
+        if getattr(cfg, "fix_data", False):
+            return fold(root_key(cfg.seed), "data_fixed")
+        return fold(rep_key, "data")
+
+    # ---- designed incomplete (swor/bernoulli), measured -------------- #
+    # [VERDICT r3 next #4] Host-designed DISTINCT tuple sets per rep
+    # (the shared parallel.partition samplers, seeded by the absolute
+    # rep index), sharded [N, per] over workers exactly like
+    # MeshBackend.incomplete's designed path: each worker regathers the
+    # rows of its sampled tuples across shards (the priced
+    # communication), evaluates locally, and psums the weighted mean.
+    # Fixed pad length -> one compile; weights price the realized set.
+    if cfg.scheme == "incomplete" and getattr(cfg, "design", "swr") != "swr":
+        from tuplewise_tpu.parallel.partition import design_pad_len
+
+        B = cfg.n_pairs
+        L = design_pad_len(B, cfg.design)
+        per = -(-L // N)
+
+        def designed_body(av, bv, w):
+            vals = kernel.pair_elementwise(av[0], bv[0], jnp)
+            s = lax.psum(jnp.sum(vals * w[0], dtype=jnp.float32), axes)
+            c = lax.psum(jnp.sum(w[0], dtype=jnp.float32), axes)
+            return s / c
+
+        designed_smap = jax.shard_map(
+            designed_body, mesh=mesh, in_specs=(PA, PA, PA),
+            out_specs=P(), check_vma=False,
+        )
+
+        def designed_tri_body(av, pv, bv, w):
+            vals = kernel.triplet_values(av[0], pv[0], bv[0], jnp)
+            s = lax.psum(jnp.sum(vals * w[0], dtype=jnp.float32), axes)
+            c = lax.psum(jnp.sum(w[0], dtype=jnp.float32), axes)
+            return s / c
+
+        designed_tri_smap = jax.shard_map(
+            designed_tri_body, mesh=mesh, in_specs=(PA, PA, PA, PA),
+            out_specs=P(), check_vma=False,
+        )
+
+        def designed_rep(args):
+            rep, idx, w = args
+            key = fold(root_key(cfg.seed), "mc_rep", rep)
+            s1, s2, *_ = gen(_data_key(key))
+            A = s1.reshape((N * cap1,) + feat)
+            Bg = A if one_sample else s2.reshape((N * cap2,) + feat)
+            if trip:
+                i, j, kk = idx
+                return designed_tri_smap(
+                    A.at[i].get(out_sharding=shard2),
+                    A.at[j].get(out_sharding=shard2),
+                    Bg.at[kk].get(out_sharding=shard2),
+                    w,
+                )
+            i, j = idx
+            return designed_smap(
+                A.at[i].get(out_sharding=shard2),
+                Bg.at[j].get(out_sharding=shard2),
+                w,
+            )
+
+        designed_run = jax.jit(
+            lambda reps, idx, W: lax.map(designed_rep, (reps, idx, W))
+        )
+
+        def runner(reps):
+            from tuplewise_tpu.parallel.partition import (
+                draw_pair_design, draw_triplet_design,
+            )
+
+            reps = np.asarray(reps)
+            M = len(reps)
+            k_idx = 3 if trip else 2
+            idx = [np.zeros((M, N, per), np.int32) for _ in range(k_idx)]
+            W = np.zeros((M, N, per), np.float32)
+            for t, r in enumerate(reps):
+                rng = np.random.default_rng(int(r))
+                if trip:
+                    drawn = draw_triplet_design(rng, n1, n2, B, cfg.design)
+                else:
+                    drawn = draw_pair_design(
+                        rng, n1, n1 - 1 if one_sample else n2, B,
+                        cfg.design, one_sample=one_sample,
+                    )
+                m = min(len(drawn[0]), N * per)
+                for arr, d in zip(idx, drawn):
+                    arr[t].reshape(-1)[:m] = d[:m]
+                W[t].reshape(-1)[:m] = 1.0
+            return designed_run(
+                jnp.asarray(reps), tuple(jnp.asarray(a) for a in idx),
+                jnp.asarray(W),
+            )
+
+        return runner
+
     # ---- estimator bodies (mirror backends.mesh_backend) ------------- #
     def complete_body(a, b, ma, mb, ia, ib):
         if trip:
@@ -197,13 +296,12 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
             )
             return (s / c)[None]
         if use_pallas:
-            from tuplewise_tpu.ops.pallas_pairs import (
-                pallas_masked_pair_sum,
-            )
+            # regathered blocks are FULL (remainder dropped), so the
+            # unmasked interior/edge path applies [VERDICT r3 next #1]
+            from tuplewise_tpu.ops.pallas_pairs import pallas_pair_sum_any
 
-            s = pallas_masked_pair_sum(
-                a[0], b[0], jnp.ones_like(a[0]), jnp.ones_like(b[0]),
-                kernel=kernel, tile_a=tile_a, tile_b=tile_b,
+            s = pallas_pair_sum_any(
+                a[0], b[0], kernel=kernel, tile_a=tile_a, tile_b=tile_b,
                 interpret=interpret,
             )
             # python float — the product can exceed int32 inside jit
@@ -276,7 +374,7 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
 
     def one_rep(rep):
         key = fold(root_key(cfg.seed), "mc_rep", rep)
-        s1, s2, ma, mb, ia, ib = gen(fold(key, "data"))
+        s1, s2, ma, mb, ia, ib = gen(_data_key(key))
         if one_sample:
             s2, mb, ib = s1, ma, ia
         if cfg.scheme == "complete":

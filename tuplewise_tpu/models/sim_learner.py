@@ -72,6 +72,7 @@ def curve_record(cfg, out, n_seeds: int) -> dict:
                 else cfg.repartition_every),
         "repartition_every": cfg.repartition_every,
         "pairs_per_worker": cfg.pairs_per_worker,
+        "pair_design": cfg.pair_design,
         "n_seeds": n_seeds,
         # 1 initial partition + one event per later boundary
         "comm_events": 1 + (cfg.steps - 1) // cfg.repartition_every,
@@ -104,10 +105,15 @@ def _compiled_sim_trainer(scorer, cfg, n1, n2):
         if cfg.pairs_per_worker is None:
             d = s1[:, None] - s2[None, :]
             return jnp.mean(kernel.diff(d, jnp))
-        i, j = pair_tiles.sample_pair_indices(
-            kk, m1, m2, cfg.pairs_per_worker, one_sample=False
+        from tuplewise_tpu.ops.device_design import (
+            draw_pair_design_device,
         )
-        return jnp.mean(kernel.diff(s1[i] - s2[j], jnp))
+
+        i, j, w = draw_pair_design_device(
+            kk, m1, m2, cfg.pairs_per_worker, cfg.pair_design
+        )
+        vals = kernel.diff(s1[i] - s2[j], jnp)
+        return jnp.sum(vals * w) / jnp.sum(w)
 
     def draw_both(kr):
         k1, k2 = jax.random.split(kr)

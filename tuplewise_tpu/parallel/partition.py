@@ -93,6 +93,20 @@ def _distinct_uniform(
     return out[:size]
 
 
+def design_pad_len(n_pairs: int, design: str) -> int:
+    """Fixed buffer length for a design's index/weight arrays — the
+    SINGLE definition shared by every consumer that pads realized
+    draws to a static shape (harness.variance, harness.mesh_mc,
+    ops.device_design). swr/swor realize exactly n_pairs; bernoulli's
+    Binomial size gets 8-sigma headroom (truncation ~1e-15/draw), so
+    one compile covers every rep."""
+    if design == "bernoulli":
+        import math
+
+        return n_pairs + 8 * int(math.ceil(math.sqrt(n_pairs))) + 8
+    return n_pairs
+
+
 def draw_pair_design(
     rng: np.random.Generator,
     n1: int,
