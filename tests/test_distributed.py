@@ -58,7 +58,43 @@ def body():
 val = jax.jit(jax.shard_map(
     body, mesh=mesh, in_specs=(), out_specs=P(), check_vma=False,
 ))()
-print("RESULT", json.dumps({"pid": pid, "value": float(val)}), flush=True)
+
+# --- mesh-MC loop across the process boundary [VERDICT r3 next #5] ---
+# repartitioned scheme: every rep's all-to-all regather crosses the
+# dcn (process) axis; estimates must match the single-process oracle
+# mesh bit-for-bit (same folds, same mesh shape and axis names).
+import numpy as np
+from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
+from tuplewise_tpu.harness.variance import VarianceConfig
+
+mc_cfg = VarianceConfig(
+    backend="mesh", scheme="repartitioned", n_pos=96, n_neg=96,
+    n_workers=4, n_rounds=2, n_reps=6,
+)
+runner = make_mesh_mc_runner(mc_cfg, mesh=mesh, tile=32)
+mc = [float(v) for v in np.asarray(runner(np.arange(6)))]
+
+# --- mesh trainer across the process boundary ------------------------
+# pmean'd grads + the repartition regather run on the (dcn, w) mesh;
+# the final parameters must match the single-process oracle trainer.
+from tuplewise_tpu.data import make_gaussians
+from tuplewise_tpu.models.pairwise_sgd import TrainConfig, train_pairwise
+from tuplewise_tpu.models.scorers import LinearScorer
+
+Xp, Xn = make_gaussians(128, 128, dim=4, separation=1.0, seed=3)
+scorer = LinearScorer(dim=4)
+t_cfg = TrainConfig(kernel="hinge", lr=0.3, steps=12, n_workers=4,
+                    repartition_every=4, seed=3, tile=32)
+params, hist = train_pairwise(
+    scorer, scorer.init(3), Xp, Xn, t_cfg, mesh=mesh,
+)
+flat = [float(x) for x in np.ravel(np.asarray(params["w"]))] + [
+    float(np.asarray(params["b"]))
+]
+print("RESULT", json.dumps({
+    "pid": pid, "value": float(val), "mc": mc, "params": flat,
+    "last_loss": float(hist["loss"][-1]),
+}), flush=True)
 """
 
 
@@ -94,13 +130,19 @@ def test_two_process_ring_matches_oracle(tmp_path):
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out)
 
-    vals = []
+    recs = []
     for out in outs:
         line = [l for l in out.splitlines() if l.startswith("RESULT ")]
         assert line, out
-        vals.append(json.loads(line[0][len("RESULT "):])["value"])
+        recs.append(json.loads(line[0][len("RESULT "):]))
+    vals = [r["value"] for r in recs]
     # both processes hold the same psum'd global estimate
     assert vals[0] == pytest.approx(vals[1], abs=1e-7)
+    # ... and identical MC estimate arrays and trained parameters
+    np.testing.assert_allclose(recs[0]["mc"], recs[1]["mc"], atol=1e-7)
+    np.testing.assert_allclose(
+        recs[0]["params"], recs[1]["params"], atol=1e-6
+    )
 
     # single-process oracle: regenerate the 4 shard blocks with the
     # same fold chain on the host and take the complete AUC
@@ -119,6 +161,43 @@ def test_two_process_ring_matches_oracle(tmp_path):
             jax.random.normal(k2, (64,), jnp.float32)))
     want = auc_score(np.concatenate(a_blocks), np.concatenate(b_blocks))
     assert vals[0] == pytest.approx(want, abs=1e-6)
+
+    # single-process oracle for the MC loop and the trainer: the SAME
+    # (2, 2) (dcn, w) mesh built from local virtual devices runs the
+    # SAME fold chains, so estimates and trajectories must agree to
+    # f32 reduction tolerance [VERDICT r3 next #5]
+    from tuplewise_tpu.data import make_gaussians
+    from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
+    from tuplewise_tpu.harness.variance import VarianceConfig
+    from tuplewise_tpu.models.pairwise_sgd import (
+        TrainConfig, train_pairwise,
+    )
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    from tuplewise_tpu.parallel.mesh import make_mesh_2d
+
+    assert jax.device_count() >= 4
+    mesh = make_mesh_2d(2, 2)
+    mc_cfg = VarianceConfig(
+        backend="mesh", scheme="repartitioned", n_pos=96, n_neg=96,
+        n_workers=4, n_rounds=2, n_reps=6,
+    )
+    runner = make_mesh_mc_runner(mc_cfg, mesh=mesh, tile=32)
+    want_mc = np.asarray(runner(np.arange(6)))
+    np.testing.assert_allclose(recs[0]["mc"], want_mc, atol=1e-6)
+
+    Xp, Xn = make_gaussians(128, 128, dim=4, separation=1.0, seed=3)
+    scorer = LinearScorer(dim=4)
+    t_cfg = TrainConfig(kernel="hinge", lr=0.3, steps=12, n_workers=4,
+                        repartition_every=4, seed=3, tile=32)
+    params, _ = train_pairwise(
+        scorer, scorer.init(3), Xp, Xn, t_cfg, mesh=mesh,
+    )
+    want_flat = np.concatenate([
+        np.ravel(np.asarray(params["w"])),
+        np.ravel(np.asarray(params["b"])),
+    ])
+    np.testing.assert_allclose(recs[0]["params"], want_flat, atol=1e-5)
 
 
 class TestFlagGating:
