@@ -162,24 +162,29 @@ def _numpy_pairs_per_sec(n=16384, reps=3):
 
 def main():
     tpu = _tpu_pairs_per_sec()
+    rec = {
+        "metric": "pairs/sec/chip",
+        "value": round(tpu, 1),
+        "unit": "pairs/s",
+    }
     try:
         ring = _ring_pairs_per_sec()
         print(
             f"[bench] ring/raw ratio = {ring / tpu:.2f}", file=sys.stderr
         )
+        rec["ring_over_raw"] = round(ring / tpu, 3)
     except Exception as e:  # pragma: no cover - diagnostic only
         print(f"[bench] ring diagnostic failed ({e!r})", file=sys.stderr)
     ref = _numpy_pairs_per_sec()
-    print(
-        json.dumps(
-            {
-                "metric": "pairs/sec/chip",
-                "value": round(tpu, 1),
-                "unit": "pairs/s",
-                "vs_baseline": round(tpu / ref, 2),
-            }
-        )
+    rec["vs_baseline"] = round(tpu / ref, 2)
+    # the caveat the dashboard needs, IN the record, not just stderr
+    # [VERDICT r3 weak #4 / next #8]: the two sides run different n
+    rec["vs_baseline_note"] = (
+        "self-baseline: frozen NumPy oracle on this host at n=16384 vs "
+        "TPU at n=2^20 (reference repo shipped no numbers; round-over-"
+        "round bookkeeping, not a like-for-like speedup)"
     )
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

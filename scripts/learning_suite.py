@@ -192,6 +192,32 @@ def stage_gauss(q, platform):
             )
 
 
+def stage_designs(q, platform):
+    """Pair-budget DESIGNS on the learning side [SURVEY §1.2 item 4;
+    VERDICT r3 next #6]: at N=128 the per-worker grid is 4x4=16 pairs,
+    so B in {4, 8} puts the budget at 25%/50% of the grid — the regime
+    where the on-device swor/bernoulli samplers (ops.device_design) cut
+    per-step gradient sampling noise by the finite-population factor
+    (1 - B/G). The sweep records whether that survives into the final
+    test-AUC floor, with the swr rows as the control."""
+    data, scorer, p0, base, S, steps = _gauss_cells(q)
+    N = 16 if q else 128
+    for design in ("swr", "swor", "bernoulli"):
+        for B in ((4,) if q else (4, 8)):
+            for nr in ((1,) if q else (1, NEVER)):
+                run_config(
+                    scorer, p0, data,
+                    dataclasses.replace(base, n_workers=N,
+                                        repartition_every=nr,
+                                        pairs_per_worker=B,
+                                        pair_design=design),
+                    n_seeds=S, eval_every=steps // 20 or 1,
+                    dataset="gaussians",
+                    out_name="learning_designs.jsonl",
+                    platform=platform,
+                )
+
+
 def stage_gauss_chip(q, platform):
     """The visible-regime sweep cells re-run ON THE TPU CHIP: jax's
     threefry PRNG is backend-deterministic, so the same seeds draw the
@@ -439,21 +465,23 @@ def stage_figs():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--stages", default="gauss,adult,mesh8,figs",
-                    help="comma list: gauss,adult,mesh8,chip,gauss-chip,trace,figs")
+    ap.add_argument("--stages", default="gauss,adult,designs,mesh8,figs",
+                    help="comma list: gauss,adult,designs,mesh8,chip,"
+                         "gauss-chip,trace,figs")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
-    known = {"gauss", "adult", "mesh8", "chip", "gauss-chip", "trace", "figs"}
+    known = {"gauss", "adult", "designs", "mesh8", "chip", "gauss-chip",
+             "trace", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}")
-    if stages & {"chip", "gauss-chip", "trace"} and stages & {"gauss", "adult", "mesh8"}:
+    if stages & {"chip", "gauss-chip", "trace"} and stages & {"gauss", "adult", "designs", "mesh8"}:
         ap.error("run --stages chip in its own invocation: the platform "
                  "(TPU vs forced-CPU) is process-global")
     global QUICK
     QUICK = args.quick
     os.makedirs(RESULTS, exist_ok=True)
 
-    if stages & {"gauss", "adult", "mesh8"}:
+    if stages & {"gauss", "adult", "designs", "mesh8"}:
         # sim sweeps + virtual mesh run on the forced-CPU platform (8
         # virtual devices for mesh8); same conftest dance as tests/
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -475,6 +503,8 @@ def main():
         stage_gauss(args.quick, platform)
     if "adult" in stages:
         stage_adult(args.quick, platform)
+    if "designs" in stages:
+        stage_designs(args.quick, platform)
     if "mesh8" in stages:
         stage_mesh8(args.quick, platform)
     if "chip" in stages:

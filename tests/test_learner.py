@@ -166,7 +166,7 @@ class TestAnalyticPairGradient:
 
     def test_dispatch_env_override_routes_to_pallas(self, monkeypatch):
         """TUPLEWISE_HARNESS_PALLAS=interpret forces the Pallas grad
-        branch of _grad_sums_dispatch on CPU; diff_pair_mean's VJP must
+        fused Pallas branch of diff_pair_mean's VJP on CPU; it must
         still match dense autodiff through it end-to-end."""
         import jax
         import jax.numpy as jnp
@@ -179,6 +179,37 @@ class TestAnalyticPairGradient:
         rng = np.random.default_rng(11)
         s1 = jnp.asarray(rng.standard_normal(130), jnp.float32)
         s2 = jnp.asarray(rng.standard_normal(70), jnp.float32)
+
+        def dense(a, b):
+            return jnp.mean(k.diff(a[:, None] - b[None, :], jnp))
+
+        g1d, g2d = jax.grad(dense, argnums=(0, 1))(s1, s2)
+        g1p, g2p = jax.grad(
+            lambda a, b: pair_tiles.diff_pair_mean(k, a, b, 32, 32),
+            argnums=(0, 1),
+        )(s1, s2)
+        np.testing.assert_allclose(g1d, g1p, atol=1e-7)
+        np.testing.assert_allclose(g2d, g2p, atol=1e-7)
+
+    def test_unfused_backward_takes_pallas_grad_kernel(self, monkeypatch):
+        """When the fused kernel's n1 SMEM-cell bound rejects a shape,
+        the backward still runs the one-pass Pallas grad kernel (its
+        row output has no cell budget); gradients must match dense
+        autodiff."""
+        import jax
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pair_tiles
+        from tuplewise_tpu.ops.kernels import get_kernel
+
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "interpret")
+        monkeypatch.setattr(
+            pair_tiles, "_use_fused_pallas", lambda k, a, b: (False, True)
+        )
+        k = get_kernel("hinge")
+        rng = np.random.default_rng(5)
+        s1 = jnp.asarray(rng.standard_normal(90), jnp.float32)
+        s2 = jnp.asarray(rng.standard_normal(110), jnp.float32)
 
         def dense(a, b):
             return jnp.mean(k.diff(a[:, None] - b[None, :], jnp))

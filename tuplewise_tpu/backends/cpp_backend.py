@@ -30,28 +30,17 @@ _DIFF_IDS = {"auc": 0, "hinge": 1, "logistic": 2}
 
 def _native_triplet_spec(kernel: Kernel):
     """(native id, margin) for the C++ triplet engine, or None for the
-    inherited NumPy path. Dispatch is by triplet_fn IDENTITY against the
-    built-in kernels (the same discipline as jax_backend's `k is
-    auc_kernel` check) — a name-colliding custom kernel with a different
-    body must NOT be routed to the built-in C++ formula. The margin is
-    then read off the function's own default — the single source of
-    truth in ops/kernels.py; a second literal here would silently
-    diverge the native path if the Python default ever changed."""
-    import inspect
+    inherited NumPy path. Dispatch and margin introspection live in the
+    SHARED builtin table (ops.kernels.builtin_triplet_spec — triplet_fn
+    identity, never name, so a shadowing custom kernel is never routed
+    to the built-in C++ formula)."""
+    from tuplewise_tpu.ops.kernels import builtin_triplet_spec
 
-    from tuplewise_tpu.ops.kernels import (
-        triplet_hinge_kernel, triplet_indicator_kernel,
-    )
-
-    ids = {
-        triplet_indicator_kernel.triplet_fn: 0,
-        triplet_hinge_kernel.triplet_fn: 1,
-    }
-    kid = ids.get(kernel.triplet_fn)
-    if kid is None:
+    spec = builtin_triplet_spec(kernel)
+    if spec is None:
         return None
-    margin = inspect.signature(kernel.triplet_fn).parameters["margin"].default
-    return kid, float(margin)
+    kind, margin = spec
+    return {"indicator": 0, "hinge": 1}[kind], margin
 
 
 def _i64p(x: Optional[np.ndarray]):

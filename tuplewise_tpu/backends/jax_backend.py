@@ -66,7 +66,16 @@ class JaxBackend:
         # ---- complete ------------------------------------------------- #
         def complete_fn(A, B):
             if k.kind == "triplet":
-                s, c = pair_tiles.triplet_stats(k, A, B, tile=triplet_tile)
+                from tuplewise_tpu.ops.pallas_triplets import (
+                    triplet_stats_best,
+                )
+
+                platform = jax.devices()[0].platform
+                s, c = triplet_stats_best(
+                    k, A, B, tile=triplet_tile,
+                    impl=impl if platform in ("tpu", "cpu") else "xla",
+                    interpret=platform == "cpu",
+                )
             elif k.two_sample:
                 from tuplewise_tpu.ops.kernels import auc_kernel
 
@@ -127,9 +136,18 @@ class JaxBackend:
                 i2 = draw_blocks(k2, B.shape[0], n_workers, scheme)
                 Ab, Bb = A[i1], B[i2]
                 if k.kind == "triplet":
+                    from tuplewise_tpu.ops.pallas_triplets import (
+                        triplet_stats_best,
+                    )
+
+                    platform = jax.devices()[0].platform
+
                     def worker(a, b, ids):
-                        s, c = pair_tiles.triplet_stats(
-                            k, a, b, ids_x=ids, tile=triplet_tile
+                        s, c = triplet_stats_best(
+                            k, a, b, ids_x=ids, tile=triplet_tile,
+                            impl=impl if platform in ("tpu", "cpu")
+                            else "xla",
+                            interpret=platform == "cpu",
                         )
                         return s / c.astype(s.dtype)
                     vals = jax.vmap(worker)(Ab, Bb, i1.astype(jnp.int32))

@@ -112,11 +112,13 @@ class MeshBackend:
                 s, c = ring.ring_triplet_stats_2d(
                     k, a[0], b[0], mask_x=ma[0], mask_y=mb[0], ids_x=ia[0],
                     ici_axis=axes[1], dcn_axis=axes[0], tile=triplet_tile,
+                    impl=impl, interpret=self._interpret,
                 )
             elif k.kind == "triplet":
                 s, c = ring.ring_triplet_stats(
                     k, a[0], b[0], mask_x=ma[0], mask_y=mb[0], ids_x=ia[0],
                     axis_name=axes[-1], tile=triplet_tile,
+                    impl=impl, interpret=self._interpret,
                 )
             elif len(axes) == 2:
                 s, c = ring.ring_pair_stats_2d(
@@ -161,8 +163,13 @@ class MeshBackend:
         def local_mean_body(a, ia, b, ib):
             """Per-shard complete U on its local block; [1, m] blocks."""
             if k.kind == "triplet":
-                s, c = pair_tiles.triplet_stats(
-                    k, a[0], b[0], ids_x=ia[0], tile=triplet_tile
+                from tuplewise_tpu.ops.pallas_triplets import (
+                    triplet_stats_best,
+                )
+
+                s, c = triplet_stats_best(
+                    k, a[0], b[0], ids_x=ia[0], tile=triplet_tile,
+                    impl=impl, interpret=self._interpret,
                 )
             elif k.two_sample:
                 s, c = pair_tiles.pair_stats(

@@ -102,6 +102,9 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
     use_pallas, interpret = resolve_pallas_mode(
         mesh.devices.flat[0].platform
     )
+    # triplet kernels route through the distance factorization
+    # (ops.pallas_triplets) under the same platform/override gate
+    use_pallas_trip = use_pallas and trip
     use_pallas = use_pallas and kernel.kind == "diff"
     impl = "pallas" if use_pallas else "xla"
     if use_pallas and not interpret:
@@ -244,16 +247,18 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
     # ---- estimator bodies (mirror backends.mesh_backend) ------------- #
     def complete_body(a, b, ma, mb, ia, ib):
         if trip:
+            trip_impl = "pallas" if use_pallas_trip else "xla"
             if len(axes) == 2:
                 s, c = ring.ring_triplet_stats_2d(
                     kernel, a[0], b[0], mask_x=ma[0], mask_y=mb[0],
                     ids_x=ia[0], ici_axis=axes[1], dcn_axis=axes[0],
-                    tile=triplet_tile,
+                    tile=triplet_tile, impl=trip_impl, interpret=interpret,
                 )
             else:
                 s, c = ring.ring_triplet_stats(
                     kernel, a[0], b[0], mask_x=ma[0], mask_y=mb[0],
                     ids_x=ia[0], axis_name=axes[0], tile=triplet_tile,
+                    impl=trip_impl, interpret=interpret,
                 )
             return s / c
         kw = dict(tile_a=tile_a, tile_b=tile_b, impl=impl,
@@ -285,8 +290,14 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
         ([N, m] with m = n // N — the random remainder is dropped by
         the permutation, so no masks are needed here)."""
         if trip:
-            s, c = pair_tiles.triplet_stats(
-                kernel, a[0], b[0], ids_x=ia[0], tile=triplet_tile
+            from tuplewise_tpu.ops.pallas_triplets import (
+                triplet_stats_best,
+            )
+
+            s, c = triplet_stats_best(
+                kernel, a[0], b[0], ids_x=ia[0], tile=triplet_tile,
+                impl="pallas" if use_pallas_trip else "xla",
+                interpret=interpret,
             )
             return (s / c)[None]
         if one_sample:

@@ -245,6 +245,29 @@ def get_kernel(name_or_kernel) -> Kernel:
         ) from None
 
 
+def builtin_triplet_spec(kernel: Kernel):
+    """("indicator" | "hinge", margin) when ``kernel`` IS one of the
+    two built-in sqdist triplet kernels (triplet_fn identity, not name
+    — a shadowing custom kernel must never match), else None. The
+    margin comes off the function's own default, so the Python
+    definition stays the single source of truth. Shared by every
+    accelerated degree-3 path (native C++ engine, Pallas distance
+    factorization) so the builtin table exists exactly once."""
+    import inspect
+
+    table = {
+        triplet_indicator_kernel.triplet_fn: "indicator",
+        triplet_hinge_kernel.triplet_fn: "hinge",
+    }
+    kind = table.get(kernel.triplet_fn)
+    if kind is None:
+        return None
+    margin = inspect.signature(
+        kernel.triplet_fn
+    ).parameters["margin"].default
+    return kind, float(margin)
+
+
 def register_kernel(kernel: Kernel) -> Kernel:
     """Register a user-defined kernel (the plugin entry point)."""
     _REGISTRY[kernel.name] = kernel

@@ -371,40 +371,82 @@ def diff_pair_mean(kernel, s1, s2, tile_a, tile_b):
     return s / c.astype(s.dtype)
 
 
-def _diff_pair_mean_fwd(kernel, s1, s2, tile_a, tile_b):
-    return diff_pair_mean(kernel, s1, s2, tile_a, tile_b), (s1, s2)
-
-
-def _grad_sums_dispatch(kernel, s1, s2, tile_a, tile_b):
-    """Best gradient path for this platform: the one-pass Pallas grad
-    kernel on TPU (ops.pallas_pairs.pallas_pair_grad_sums — forward-rate
-    row/col g' sums [VERDICT r3 next #2]), the XLA scan otherwise. The
-    Pallas col accumulator holds the padded b side resident in VMEM, so
-    huge n2 stays on XLA (trainer blocks are far below the bound).
-    TUPLEWISE_HARNESS_PALLAS=interpret|off overrides, as in the harness
-    hot loops."""
+def _use_fused_pallas(kernel, s1, s2):
+    """True when the ONE-PASS fused Pallas loss+grad kernel serves this
+    platform and shape [VERDICT r3 next #2]: the col accumulator holds
+    the padded b side resident in VMEM (so huge n2 stays off), and the
+    per-row-block loss cells bound n1 by the SMEM budget (the two-pass
+    pallas_pair_grad_sums backward covers larger n1 — no SMEM cells).
+    TUPLEWISE_HARNESS_PALLAS=interpret|off overrides, as in the
+    harness hot loops."""
     import jax
 
-    from tuplewise_tpu.ops.pallas_pairs import resolve_pallas_mode
+    from tuplewise_tpu.ops.pallas_pairs import (
+        MAX_ROW_BLOCKS, resolve_pallas_mode,
+    )
 
     use_pallas, interpret = resolve_pallas_mode(
         jax.devices()[0].platform
     )
-    if use_pallas and s2.shape[0] <= 1_000_000:  # ~4 MB VMEM col bound
-        from tuplewise_tpu.ops.pallas_pairs import pallas_pair_grad_sums
+    return (
+        use_pallas and kernel.diff_grad_fn is not None
+        and s2.shape[0] <= 1_000_000  # ~4 MB VMEM col bound
+        and -(-s1.shape[0] // 1024) <= MAX_ROW_BLOCKS,  # SMEM cells
+        interpret,
+    )
 
-        row, col = pallas_pair_grad_sums(
+
+def _diff_pair_mean_fwd(kernel, s1, s2, tile_a, tile_b):
+    fused, interpret = _use_fused_pallas(kernel, s1, s2)
+    if fused:
+        from tuplewise_tpu.ops.pallas_pairs import pallas_pair_loss_grad
+
+        s, row, col = pallas_pair_loss_grad(
             s1, s2, kernel=kernel, interpret=interpret
         )
-        return row.astype(s1.dtype), col.astype(s2.dtype)
-    return pair_grad_sums(kernel, s1, s2, tile_a=tile_a, tile_b=tile_b)
+        cnt = float(s1.shape[0] * s2.shape[0])
+        # residuals ARE the gradient reductions: the backward costs
+        # O(n) scaling, the whole step touches the grid once
+        return (s / cnt).astype(s1.dtype), (
+            (row.astype(s1.dtype), col.astype(s2.dtype)), None
+        )
+    s, c = pair_stats(kernel, s1, s2, tile_a=tile_a, tile_b=tile_b)
+    return s / c.astype(s.dtype), (None, (s1, s2))
 
 
 def _diff_pair_mean_bwd(kernel, tile_a, tile_b, res, ct):
-    s1, s2 = res
-    row, col = _grad_sums_dispatch(kernel, s1, s2, tile_a, tile_b)
+    precomputed, data = res
+    if precomputed is not None:
+        row, col = precomputed
+    else:
+        s1, s2 = data
+        import jax
+
+        from tuplewise_tpu.ops.pallas_pairs import resolve_pallas_mode
+
+        use_pallas, interpret = resolve_pallas_mode(
+            jax.devices()[0].platform
+        )
+        if (use_pallas and kernel.diff_grad_fn is not None
+                and s2.shape[0] <= 1_000_000):
+            # n1 too large for the fused kernel's SMEM loss cells:
+            # still take the ONE-PASS Pallas backward (its row output
+            # is per-block VMEM, no cell budget); only the forward
+            # pays the XLA scan
+            from tuplewise_tpu.ops.pallas_pairs import (
+                pallas_pair_grad_sums,
+            )
+
+            row, col = pallas_pair_grad_sums(
+                s1, s2, kernel=kernel, interpret=interpret
+            )
+        else:
+            row, col = pair_grad_sums(
+                kernel, s1, s2, tile_a=tile_a, tile_b=tile_b
+            )
+        row, col = row.astype(s1.dtype), col.astype(s2.dtype)
     # python float, not int: the pair count can exceed int32 inside jit
-    inv = ct / float(s1.shape[0] * s2.shape[0])
+    inv = ct / float(row.shape[0] * col.shape[0])
     # d/ds1_i = +mean_j g'; d/ds2_j carries the -1 from d = s1 - s2
     return inv * row, -inv * col
 

@@ -1,0 +1,189 @@
+"""Pallas-rate degree-3 reductions via distance factorization
+[VERDICT r3 next #3 — the last hot loop without a hand-tiled path].
+
+The built-in triplet kernels (ops.kernels) depend on the three points
+ONLY through the two anchor distances:
+
+    h(a, p, n) = g( d(a,p) - d(a,n) ),       d = squared euclidean
+    indicator: g(t) = 1{t < -margin}    hinge: g(t) = max(0, margin+t)
+
+so the O(n^3 d) triple loop factorizes into O(n^2 d) MXU distance
+matmuls + an O(n^3) SCALAR pair reduction per anchor — the same trick
+as the native C++ engine's distance-reuse loop
+(native/pair_sum.cpp::triplet_stats_native), mapped to TPU:
+
+1. anchors stream in chunks; per chunk the two distance matrices
+   D_ap [C, P] and D_an [C, K] come from one |a|^2/|b|^2/a@b.T
+   assembly each (MXU work);
+2. per anchor row, sum_{j,k} g(D_ap[j] - D_an[k]) is EXACTLY the
+   masked pair-sum problem on score vectors (D_ap[i], D_an[i]) with
+   the combine g as a diff kernel — the hand-tiled
+   `pallas_masked_pair_sum` runs it under `jax.vmap` over the chunk,
+   per-anchor j-masks carrying the ids_x != ids_p exclusion.
+
+No new Pallas kernel: the pair kernel's sublane x lane layout, SMEM
+Kahan cells, and vmap batching are reused as-is. Only the two built-in
+triplet kernels qualify (identity dispatch on triplet_fn, margin read
+off the function default — the cpp_backend discipline); custom triplet
+kernels keep the XLA tile path (ops.pair_tiles.triplet_stats).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tuplewise_tpu.ops.kernels import Kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _combine_kernel(base_fn, margin: float, indicator: bool) -> Kernel:
+    """The scalar combine g as a registered-shape diff Kernel, cached so
+    the jitted pair kernels see one static object per (fn, margin)."""
+    if indicator:
+        def g(d, xp):
+            return xp.where(d < -margin, 1.0, 0.0)
+    else:
+        def g(d, xp):
+            return xp.maximum(0.0, margin + d)
+    return Kernel(
+        name=f"_triplet_combine_{'ind' if indicator else 'hinge'}_{margin}",
+        degree=2, two_sample=True, kind="diff", diff_fn=g,
+        higher_is_better=indicator,
+    )
+
+
+def triplet_combine_kernel(kernel: Kernel) -> Optional[Kernel]:
+    """The distance-difference combine for a built-in triplet kernel,
+    or None when the kernel does not factorize (custom triplet_fn).
+    Identity dispatch + margin come from the shared builtin table
+    (ops.kernels.builtin_triplet_spec)."""
+    from tuplewise_tpu.ops.kernels import builtin_triplet_spec
+
+    spec = builtin_triplet_spec(kernel)
+    if spec is None:
+        return None
+    kind, margin = spec
+    return _combine_kernel(kernel.triplet_fn, margin, kind == "indicator")
+
+
+def _sqdist_matrix(a, b):
+    """[C, m] squared euclidean distances via the MXU contraction."""
+    an = jnp.sum(a * a, axis=-1)
+    bn = jnp.sum(b * b, axis=-1)
+    return an[:, None] + bn[None, :] - 2.0 * (a @ b.T)
+
+
+def pallas_triplet_stats(
+    kernel: Kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    mask_x: Optional[jnp.ndarray] = None,
+    mask_y: Optional[jnp.ndarray] = None,
+    ids_x: Optional[jnp.ndarray] = None,
+    *,
+    positives: Optional[jnp.ndarray] = None,
+    mask_p: Optional[jnp.ndarray] = None,
+    ids_p: Optional[jnp.ndarray] = None,
+    anchor_chunk: int = 512,
+    tile_p: int = 512,
+    tile_k: int = 4096,
+    interpret: bool = False,
+):
+    # defaults measured on v5e at n=4096, d=32: 3.51e11 triplets/s
+    # (XLA tile scan: 1.0e11); wider k-tiles (8192) drop to 2.5e11
+    """(sum, count) of h(x_i, p_j, y_k) over ids_x[i] != ids_p[j] — the
+    same contract as ops.pair_tiles.triplet_stats, at pair-kernel rate.
+
+    Raises ValueError for kernels that don't factorize; callers
+    (ring._triplet_block, backends) check triplet_combine_kernel first
+    and fall back to the XLA path.
+    """
+    combine = triplet_combine_kernel(kernel)
+    if combine is None:
+        raise ValueError(
+            f"triplet kernel {kernel.name!r} has no distance "
+            "factorization; use pair_tiles.triplet_stats"
+        )
+    from tuplewise_tpu.ops.pair_tiles import _pad_axis0
+    from tuplewise_tpu.ops.pallas_pairs import pallas_masked_pair_sum
+
+    dtype = X.dtype
+    mx = jnp.ones(X.shape[0], dtype) if mask_x is None else mask_x
+    my = jnp.ones(Y.shape[0], dtype) if mask_y is None else mask_y
+    ix = (jnp.arange(X.shape[0]) if ids_x is None else ids_x
+          ).astype(jnp.int32)
+    if positives is None:
+        positives, mp_, ip = X, mx, ix
+    else:
+        mp_ = (jnp.ones(positives.shape[0], dtype)
+               if mask_p is None else mask_p)
+        ip = (jnp.arange(positives.shape[0]) if ids_p is None else ids_p
+              ).astype(jnp.int32)
+
+    # clamp the measured-best shapes down for small inputs: the pair
+    # kernel pads every side up to a full tile, so tiles far beyond the
+    # data would spend almost all lanes on zero-mask padding (the same
+    # rule as mesh_mc._clamp_preferred; interpret-mode tests at n~50
+    # would otherwise emulate 512x4096 grids of padding)
+    def _clamp(t, m, floor):
+        while t >= 2 * m and t > floor:
+            t //= 2
+        return t
+
+    C = _clamp(anchor_chunk, X.shape[0], 8)
+    tile_p = _clamp(tile_p, positives.shape[0], 8)
+    tile_k = _clamp(tile_k, Y.shape[0], 128)
+    Xc = _pad_axis0(X, C).reshape(-1, C, X.shape[-1])
+    mxc = _pad_axis0(mx, C).reshape(-1, C)
+    # padded anchors must not collide with any positive id: ids are
+    # nonnegative, so -1 never matches
+    ixc = _pad_axis0(ix + 1, C).reshape(-1, C) - 1
+
+    def per_anchor(dap, dan, mj):
+        s = pallas_masked_pair_sum(
+            dap, dan, mj, my, kernel=combine,
+            tile_a=tile_p, tile_b=tile_k, interpret=interpret,
+        )
+        return s, jnp.sum(mj) * jnp.sum(my)
+
+    def chunk_stats(args):
+        a, ma, ia = args
+        dap = _sqdist_matrix(a, positives)          # [C, P] MXU
+        dan = _sqdist_matrix(a, Y)                  # [C, K] MXU
+        mj = (mp_[None, :]
+              * (ia[:, None] != ip[None, :]).astype(dtype))  # [C, P]
+        s, c = jax.vmap(per_anchor)(dap, dan, mj)
+        return jnp.sum(s * ma), jnp.sum(c * ma)
+
+    # lax.map over anchor chunks bounds the live distance matrices at
+    # [C, max(P, K)] while the vmapped pair kernel fills the chip
+    s, c = lax.map(chunk_stats, (Xc, mxc, ixc))
+    return jnp.sum(s).astype(dtype), jnp.sum(c).astype(dtype)
+
+
+def triplet_stats_best(
+    kernel: Kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    *,
+    impl: str = "xla",
+    interpret: Optional[bool] = None,
+    tile: int = 128,
+    **kw,
+):
+    """The shared dispatch every degree-3 call site uses (ring blocks,
+    backends, harness bodies): the Pallas distance factorization when
+    impl="pallas" and the kernel factorizes, the checkpointed XLA tile
+    scan otherwise. Same (sum, count) contract either way."""
+    if impl == "pallas" and triplet_combine_kernel(kernel) is not None:
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+        return pallas_triplet_stats(kernel, X, Y, interpret=interpret, **kw)
+    from tuplewise_tpu.ops import pair_tiles
+
+    return pair_tiles.triplet_stats(kernel, X, Y, tile=tile, **kw)
