@@ -218,6 +218,103 @@ def stage_designs(q, platform):
                 )
 
 
+def stage_triplet(q, platform):
+    """Degree-3 metric learning [VERDICT r3 next #9]: the triplet-hinge
+    learner (models.triplet_sgd) trained through a k=2 embedding
+    bottleneck, held-out triplet accuracy as the curve — config 4
+    turned into a LEARNING config. Two tasks:
+
+    * gauss-overlap: overlapping Gaussian clouds (separation 1.0,
+      d=16), a nontrivial accuracy ceiling set by the class overlap;
+    * mnist-surrogate: class 3 vs rest of the MNIST-embedding
+      surrogate (separable by construction, meta-stamped synthetic —
+      the curve shows recovery through the bottleneck).
+
+    Repartition schedule sweep n_r in {1, 25, never}, S seeds each.
+    """
+    import numpy as np
+
+    from tuplewise_tpu.data import load_mnist_embeddings, make_gaussians
+    from tuplewise_tpu.models.triplet_sgd import (
+        TripletTrainConfig, evaluate_triplet_accuracy, init_embed,
+        train_triplet,
+    )
+
+    S = 2 if q else 8
+    steps = 30 if q else 300
+    N = 4 if q else 8
+
+    def split(X, frac, rng):
+        p = rng.permutation(len(X))
+        t = int(frac * len(X))
+        return X[p[:t]], X[p[t:]]
+
+    def task_data(task, seed):
+        rng = np.random.default_rng(seed)
+        if task == "gauss-overlap":
+            n = 240 if q else 2_000
+            # overlapping clouds: the optimal metric projects onto the
+            # shift direction and the class overlap caps accuracy well
+            # below 1 — a nontrivial ceiling. (No rotation: isotropic
+            # covariance + rotation-invariant init make a rotated task
+            # distributionally identical — reviewer r4.)
+            X, Y = make_gaussians(n, 3 * n, dim=16, separation=1.0,
+                                  seed=seed)
+        else:
+            n_all = 400 if q else 4_000
+            E, labels, _ = load_mnist_embeddings(n=n_all, seed=seed)
+            X, Y = E[labels == 3], E[labels != 3]
+        Xc_tr, Xc_te = split(np.asarray(X, np.float32), 0.75, rng)
+        Xo_tr, Xo_te = split(np.asarray(Y, np.float32), 0.75, rng)
+        return Xc_tr, Xo_tr, Xc_te, Xo_te
+
+    for task in ("gauss-overlap", "mnist-surrogate"):
+        for nr in ((1,) if q else (1, 25, NEVER)):
+            accs, curves, acc0s = [], [], []
+            t0 = time.perf_counter()
+            for s in range(S):
+                Xc_tr, Xo_tr, Xc_te, Xo_te = task_data(task, s)
+                dim = Xc_tr.shape[1]
+                p0 = init_embed(dim, 2, seed=s)
+                acc0s.append(
+                    evaluate_triplet_accuracy(p0, Xc_te, Xo_te)
+                )
+                cfg = TripletTrainConfig(
+                    lr=0.1, steps=steps, n_workers=N,
+                    repartition_every=nr,
+                    triplets_per_worker=512 if q else 4_096,
+                    seed=1_000 + s, embed_dim=2,
+                )
+                _, hist = train_triplet(
+                    p0, Xc_tr, Xo_tr, cfg,
+                    eval_every=max(steps // 10, 1),
+                    eval_data=(Xc_te, Xo_te),
+                )
+                curves.append(hist["test_acc"])
+                accs.append(float(hist["test_acc"][-1]))
+            wc = time.perf_counter() - t0
+            accs = np.asarray(accs)
+            curve = np.mean(np.stack(curves), axis=0)
+            rec = {
+                "task": task, "embed_dim": 2, "n_workers": N,
+                "n_r": None if nr >= NEVER else nr,
+                "repartition_every": nr, "steps": steps,
+                "triplets_per_worker": 512 if q else 4_096,
+                "n_seeds": S,
+                "acc_init_mean": round(float(np.mean(acc0s)), 6),
+                "acc_curve_mean": np.round(curve, 6).tolist(),
+                "final_acc_mean": round(float(accs.mean()), 6),
+                "final_acc_se": round(
+                    float(accs.std(ddof=1) / np.sqrt(S)), 6
+                ) if S > 1 else None,
+                "wallclock_s": round(wc, 2), "platform": platform,
+            }
+            emit(rec, "learning_triplet.jsonl")
+            log(f"triplet {task} n_r={rec['n_r']} "
+                f"final={rec['final_acc_mean']:.5f} "
+                f"(init {rec['acc_init_mean']:.5f}) ({wc:.1f}s)")
+
+
 def stage_gauss_chip(q, platform):
     """The visible-regime sweep cells re-run ON THE TPU CHIP: jax's
     threefry PRNG is backend-deterministic, so the same seeds draw the
@@ -407,8 +504,8 @@ def stage_trace(q, platform):
 
 def stage_figs():
     from tuplewise_tpu.harness.figures import (
-        plot_auc_vs_budget, plot_auc_vs_comm, plot_learning_curves,
-        plot_sd_vs_comm,
+        plot_auc_vs_budget, plot_auc_vs_comm, plot_design_budget,
+        plot_learning_curves, plot_sd_vs_comm, plot_triplet_curves,
     )
 
     os.makedirs(FIGS, exist_ok=True)
@@ -459,29 +556,46 @@ def stage_figs():
             fig_path("learning_auc_vs_budget.png"),
             title=f"gaussians, N={N}: pair budget x repartition",
         )
+    d_rows = load("learning_designs.jsonl")
+    if d_rows:
+        plot_design_budget(
+            d_rows,
+            fig_path("learning_design_budget.png"),
+            title=f"gaussians, N={d_rows[0]['n_workers']}: pair-budget "
+                  "DESIGNS (B/G = 25%, 50% of the per-worker grid)",
+        )
+    t_rows = load("learning_triplet.jsonl")
+    if t_rows:
+        plot_triplet_curves(
+            t_rows,
+            fig_path("learning_triplet_curves.png"),
+            title="degree-3 metric learner, k=2 bottleneck",
+        )
     log(f"figures written to {FIGS}")
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--stages", default="gauss,adult,designs,mesh8,figs",
-                    help="comma list: gauss,adult,designs,mesh8,chip,"
-                         "gauss-chip,trace,figs")
+    ap.add_argument("--stages",
+                    default="gauss,adult,designs,triplet,mesh8,figs",
+                    help="comma list: gauss,adult,designs,triplet,mesh8,"
+                         "chip,gauss-chip,trace,figs")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
-    known = {"gauss", "adult", "designs", "mesh8", "chip", "gauss-chip",
-             "trace", "figs"}
+    known = {"gauss", "adult", "designs", "triplet", "mesh8", "chip",
+             "gauss-chip", "trace", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}")
-    if stages & {"chip", "gauss-chip", "trace"} and stages & {"gauss", "adult", "designs", "mesh8"}:
+    _cpu_stages = {"gauss", "adult", "designs", "triplet", "mesh8"}
+    if stages & {"chip", "gauss-chip", "trace"} and stages & _cpu_stages:
         ap.error("run --stages chip in its own invocation: the platform "
                  "(TPU vs forced-CPU) is process-global")
     global QUICK
     QUICK = args.quick
     os.makedirs(RESULTS, exist_ok=True)
 
-    if stages & {"gauss", "adult", "designs", "mesh8"}:
+    if stages & {"gauss", "adult", "designs", "triplet", "mesh8"}:
         # sim sweeps + virtual mesh run on the forced-CPU platform (8
         # virtual devices for mesh8); same conftest dance as tests/
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -505,6 +619,8 @@ def main():
         stage_adult(args.quick, platform)
     if "designs" in stages:
         stage_designs(args.quick, platform)
+    if "triplet" in stages:
+        stage_triplet(args.quick, platform)
     if "mesh8" in stages:
         stage_mesh8(args.quick, platform)
     if "chip" in stages:

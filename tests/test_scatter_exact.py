@@ -1,0 +1,89 @@
+"""Exact O(n d) scatter closed form (ops.scatter_exact) [VERDICT r3
+next #7]: must match the streamed tile reduction bit-tightly on every
+mask/id configuration the library produces, including swr duplicate
+ids (where equal ids mean IDENTICAL rows by the id discipline)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tuplewise_tpu.ops.kernels import Kernel, scatter_kernel
+from tuplewise_tpu.ops.pair_tiles import pair_stats
+from tuplewise_tpu.ops.scatter_exact import (
+    is_builtin_scatter, scatter_pair_stats,
+)
+
+
+class TestScatterClosedForm:
+    def test_two_sample_masked_parity(self):
+        rng = np.random.default_rng(0)
+        A = jnp.asarray(rng.normal(size=(137, 7)).astype(np.float32))
+        B = jnp.asarray(rng.normal(size=(90, 7)).astype(np.float32))
+        ma = jnp.asarray((rng.random(137) > 0.2).astype(np.float32))
+        mb = jnp.asarray((rng.random(90) > 0.3).astype(np.float32))
+        se, ce = scatter_pair_stats(A, B, ma, mb)
+        sx, cx = pair_stats(scatter_kernel, A, B, mask_a=ma, mask_b=mb,
+                            tile_a=32, tile_b=32)
+        assert float(se) == pytest.approx(float(sx), rel=1e-5)
+        assert float(ce) == float(cx)
+
+    def test_one_sample_swr_duplicate_ids(self):
+        """Duplicate ids (swr resampling) reference identical rows;
+        the dup-count sort must reproduce pair_stats' id exclusion."""
+        rng = np.random.default_rng(1)
+        ids = jnp.asarray(rng.integers(0, 60, 137), jnp.int32)
+        base = jnp.asarray(rng.normal(size=(60, 7)).astype(np.float32))
+        A = base[ids]
+        ma = jnp.asarray((rng.random(137) > 0.2).astype(np.float32))
+        se, ce = scatter_pair_stats(A, A, ma, ma, ids, ids)
+        sx, cx = pair_stats(scatter_kernel, A, A, mask_a=ma, mask_b=ma,
+                            ids_a=ids, ids_b=ids, tile_a=32, tile_b=32)
+        assert float(se) == pytest.approx(float(sx), rel=1e-5)
+        assert float(ce) == float(cx)
+
+    def test_one_sample_distinct_ids_vmaps(self):
+        """The local-average worker path vmaps the closed form over
+        blocks (incl. the dup-count sort)."""
+        rng = np.random.default_rng(2)
+        A = jnp.asarray(rng.normal(size=(4, 50, 5)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, 30, (4, 50)), jnp.int32)
+        se, ce = jax.vmap(
+            lambda a, i: scatter_pair_stats(a, a, ids_a=i, ids_b=i)
+        )(A, ids)
+        for w in range(4):
+            sx, cx = pair_stats(
+                scatter_kernel, A[w], A[w], ids_a=ids[w], ids_b=ids[w],
+                tile_a=16, tile_b=16,
+            )
+            # sums differ where equal-id rows differ (random rows here),
+            # so only audit the count identity, which is row-agnostic
+            assert float(ce[w]) == float(cx)
+
+    def test_identity_dispatch(self):
+        assert is_builtin_scatter(scatter_kernel)
+        shadow = Kernel(name="scatter", degree=2, two_sample=False,
+                        kind="pair",
+                        pair_fn=lambda a, b, xp: xp.zeros(
+                            (a.shape[0], b.shape[0])))
+        assert not is_builtin_scatter(shadow)
+
+    def test_backend_estimates_unchanged(self):
+        """The jax backend's scatter estimates (now closed-form) must
+        match the numpy oracle exactly."""
+        from tuplewise_tpu import Estimator
+        from tuplewise_tpu.data import make_gaussians
+
+        X, _ = make_gaussians(300, 10, dim=4, separation=1.0, seed=3)
+        ref = Estimator("scatter", backend="numpy",
+                        n_workers=4).complete(X)
+        got = Estimator("scatter", backend="jax",
+                        n_workers=4).complete(X)
+        assert got == pytest.approx(ref, rel=1e-5)
+        ref_l = Estimator("scatter", backend="numpy",
+                          n_workers=4).local_average(X, seed=0)
+        got_l = Estimator("scatter", backend="jax",
+                          n_workers=4).local_average(X, seed=0)
+        # different PRNGs draw different partitions; statistical check
+        assert abs(got_l - ref_l) < 0.2

@@ -108,10 +108,21 @@ class JaxBackend:
                 )
             else:
                 ids = jnp.arange(A.shape[0], dtype=jnp.int32)
-                s, c = pair_tiles.pair_stats(
-                    k, A, A, ids_a=ids, ids_b=ids,
-                    tile_a=tile_a, tile_b=tile_b,
+                from tuplewise_tpu.ops.scatter_exact import (
+                    is_builtin_scatter, scatter_pair_stats,
                 )
+
+                if is_builtin_scatter(k):
+                    # polynomial kernel: exact O(n d) moment form, no
+                    # pair grid at all [VERDICT r3 next #7]
+                    s, c = scatter_pair_stats(
+                        A, A, ids_a=ids, ids_b=ids
+                    )
+                else:
+                    s, c = pair_tiles.pair_stats(
+                        k, A, A, ids_a=ids, ids_b=ids,
+                        tile_a=tile_a, tile_b=tile_b,
+                    )
             return s / c.astype(s.dtype)
 
         self._complete = jax.jit(complete_fn)
@@ -161,12 +172,23 @@ class JaxBackend:
             else:
                 idx = draw_blocks(key, A.shape[0], n_workers, scheme)
                 Ab = A[idx]
-                def worker(a, ids):
-                    s, c = pair_tiles.pair_stats(
-                        k, a, a, ids_a=ids, ids_b=ids,
-                        tile_a=tile_a, tile_b=tile_b,
-                    )
-                    return s / c.astype(s.dtype)
+                from tuplewise_tpu.ops.scatter_exact import (
+                    is_builtin_scatter, scatter_pair_stats,
+                )
+
+                if is_builtin_scatter(k):
+                    def worker(a, ids):
+                        s, c = scatter_pair_stats(
+                            a, a, ids_a=ids, ids_b=ids
+                        )
+                        return s / c.astype(s.dtype)
+                else:
+                    def worker(a, ids):
+                        s, c = pair_tiles.pair_stats(
+                            k, a, a, ids_a=ids, ids_b=ids,
+                            tile_a=tile_a, tile_b=tile_b,
+                        )
+                        return s / c.astype(s.dtype)
                 vals = jax.vmap(worker)(Ab, idx.astype(jnp.int32))
             alive = alive.astype(vals.dtype)
             return jnp.sum(vals * alive) / jnp.sum(alive)

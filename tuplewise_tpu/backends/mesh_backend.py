@@ -108,7 +108,20 @@ class MeshBackend:
             # the unmasked Pallas fast path [VERDICT r2 next #3].
             pair_mask_a = None if no_masks else ma[0]
             pair_mask_b = None if no_masks else mb[0]
-            if k.kind == "triplet" and len(axes) == 2:
+            from tuplewise_tpu.ops.scatter_exact import (
+                is_builtin_scatter, scatter_mesh_stats,
+            )
+
+            if is_builtin_scatter(k):
+                # polynomial kernel: the ENTIRE cross-shard statistic
+                # is one O(d) psum of moments — no ring at all
+                # [VERDICT r3 next #7]; the complete packing's global
+                # ids are distinct, as the one_sample count requires
+                s, c = scatter_mesh_stats(
+                    a[0], ma[0], b[0], mb[0], axes=axes,
+                    one_sample=not k.two_sample,
+                )
+            elif k.kind == "triplet" and len(axes) == 2:
                 s, c = ring.ring_triplet_stats_2d(
                     k, a[0], b[0], mask_x=ma[0], mask_y=mb[0], ids_x=ia[0],
                     ici_axis=axes[1], dcn_axis=axes[0], tile=triplet_tile,
@@ -176,10 +189,19 @@ class MeshBackend:
                     k, a[0], b[0], tile_a=tile_a, tile_b=tile_b
                 )
             else:
-                s, c = pair_tiles.pair_stats(
-                    k, a[0], a[0], ids_a=ia[0], ids_b=ib[0],
-                    tile_a=tile_a, tile_b=tile_b,
+                from tuplewise_tpu.ops.scatter_exact import (
+                    is_builtin_scatter, scatter_pair_stats,
                 )
+
+                if is_builtin_scatter(k):
+                    s, c = scatter_pair_stats(
+                        a[0], a[0], ids_a=ia[0], ids_b=ib[0]
+                    )
+                else:
+                    s, c = pair_tiles.pair_stats(
+                        k, a[0], a[0], ids_a=ia[0], ids_b=ib[0],
+                        tile_a=tile_a, tile_b=tile_b,
+                    )
             return (s / c)[None]
 
         local_mean_smap = jax.shard_map(

@@ -357,3 +357,83 @@ def plot_sd_vs_comm(rows, out_png: str,
     fig.savefig(out_png, dpi=150)
     plt.close(fig)
     return out_png
+
+
+def plot_design_budget(rows, out_png: str, title: str = "") -> str:
+    """Final held-out AUC vs per-worker budget B, one line per pair
+    DESIGN (swr/swor/bernoulli) at each repartition period — does the
+    finite-population design reach a better budget-noise floor?
+    [SURVEY §1.2 item 4; VERDICT r3 next #6]."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = _results(rows)
+    fig, ax = plt.subplots(figsize=(5.5, 4))
+    markers = {"swr": "o", "swor": "s", "bernoulli": "^"}
+    for nr in sorted({r.get("n_r") for r in rows},
+                     key=lambda v: (v is None, v or 0)):
+        for design in ("swr", "swor", "bernoulli"):
+            rs = sorted(
+                (r for r in rows
+                 if r.get("n_r") == nr
+                 and r.get("pair_design", "swr") == design),
+                key=lambda r: r["pairs_per_worker"],
+            )
+            if not rs:
+                continue
+            x = [r["pairs_per_worker"] for r in rs]
+            y = [r["final_auc_mean"] for r in rs]
+            e = [2 * (r["final_auc_se"] or 0.0) for r in rs]
+            ax.errorbar(
+                x, y, yerr=e, marker=markers[design], ms=4, lw=1.2,
+                capsize=2,
+                label=f"{design}, {_nr_label(rs[0])}",
+            )
+    ax.set_xlabel("pairs per worker per step B")
+    ax.set_ylabel("final held-out AUC")
+    if title:
+        ax.set_title(title, fontsize=9)
+    ax.legend(fontsize=7)
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png
+
+
+def plot_triplet_curves(rows, out_png: str, title: str = "") -> str:
+    """Held-out triplet-accuracy curves of the degree-3 metric learner
+    (models.triplet_sgd), one line per repartition period, one panel
+    per task [VERDICT r3 next #9]."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = _results(rows)
+    tasks = sorted({r["task"] for r in rows})
+    fig, axes = plt.subplots(
+        1, len(tasks), figsize=(5.0 * len(tasks), 4), squeeze=False
+    )
+    for ax, task in zip(axes[0], tasks):
+        for r in sorted(
+            (r for r in rows if r["task"] == task),
+            key=lambda r: (r["n_r"] is None, r["n_r"] or 0),
+        ):
+            curve = r["acc_curve_mean"]
+            steps = r["steps"]
+            x = [steps * (i + 1) / len(curve)
+                 for i in range(len(curve))]
+            ax.plot([0] + x, [r["acc_init_mean"]] + list(curve),
+                    marker="o", ms=3, lw=1.2, label=_nr_label(r))
+        ax.set_xlabel("step")
+        ax.set_ylabel("held-out triplet accuracy")
+        ax.set_title(task, fontsize=9)
+        ax.legend(fontsize=8)
+    if title:
+        fig.suptitle(title, fontsize=10)
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=150)
+    plt.close(fig)
+    return out_png

@@ -261,6 +261,18 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
                     impl=trip_impl, interpret=interpret,
                 )
             return s / c
+        from tuplewise_tpu.ops.scatter_exact import (
+            is_builtin_scatter, scatter_mesh_stats,
+        )
+
+        if is_builtin_scatter(kernel):
+            # one O(d) psum of moments replaces the ring entirely
+            # [VERDICT r3 next #7]; gen's global ids are distinct
+            s, c = scatter_mesh_stats(
+                a[0], ma[0], b[0], mb[0], axes=axes,
+                one_sample=one_sample,
+            )
+            return s / c
         kw = dict(tile_a=tile_a, tile_b=tile_b, impl=impl,
                   interpret=interpret)
         # mask=None on padding-free shards certifies the unmasked
@@ -301,10 +313,19 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
             )
             return (s / c)[None]
         if one_sample:
-            s, c = pair_tiles.pair_stats(
-                kernel, a[0], a[0], ids_a=ia[0], ids_b=ib[0],
-                tile_a=min(tile_a, m1), tile_b=min(tile_b, m1),
+            from tuplewise_tpu.ops.scatter_exact import (
+                is_builtin_scatter, scatter_pair_stats,
             )
+
+            if is_builtin_scatter(kernel):
+                s, c = scatter_pair_stats(
+                    a[0], a[0], ids_a=ia[0], ids_b=ib[0]
+                )
+            else:
+                s, c = pair_tiles.pair_stats(
+                    kernel, a[0], a[0], ids_a=ia[0], ids_b=ib[0],
+                    tile_a=min(tile_a, m1), tile_b=min(tile_b, m1),
+                )
             return (s / c)[None]
         if use_pallas:
             # regathered blocks are FULL (remainder dropped), so the

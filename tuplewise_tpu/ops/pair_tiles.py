@@ -382,7 +382,7 @@ def _use_fused_pallas(kernel, s1, s2):
     import jax
 
     from tuplewise_tpu.ops.pallas_pairs import (
-        MAX_ROW_BLOCKS, resolve_pallas_mode,
+        FUSED_TILE_A, MAX_ROW_BLOCKS, resolve_pallas_mode,
     )
 
     use_pallas, interpret = resolve_pallas_mode(
@@ -391,7 +391,8 @@ def _use_fused_pallas(kernel, s1, s2):
     return (
         use_pallas and kernel.diff_grad_fn is not None
         and s2.shape[0] <= 1_000_000  # ~4 MB VMEM col bound
-        and -(-s1.shape[0] // 1024) <= MAX_ROW_BLOCKS,  # SMEM cells
+        # SMEM loss-cell budget at the fused kernel's own row tile
+        and -(-s1.shape[0] // FUSED_TILE_A) <= MAX_ROW_BLOCKS,
         interpret,
     )
 

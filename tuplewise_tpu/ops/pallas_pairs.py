@@ -367,6 +367,11 @@ def _pair_grad_kernel(a_ref, b_ref, ma_ref, mb_ref, row_ref, col_ref,
     col_ref[:, sl] = col_ref[:, sl] + colpart
 
 
+# the fused kernel's row tile — ONE constant shared with the dispatch
+# gate (pair_tiles._use_fused_pallas derives its n1 bound from it)
+FUSED_TILE_A = 1024
+
+
 def _fused_loss_grad_kernel(a_ref, b_ref, ma_ref, mb_ref,
                             loss_ref, row_ref, col_ref, *, g, gp, tile_b):
     """One grid pass computing the masked loss sum (Kahan SMEM cells,
@@ -409,7 +414,7 @@ def pallas_pair_loss_grad(
     s2: jnp.ndarray,
     *,
     kernel: Kernel,
-    tile_a: int = 1024,
+    tile_a: int = FUSED_TILE_A,
     tile_b: int = 2048,
     interpret: bool = False,
 ):

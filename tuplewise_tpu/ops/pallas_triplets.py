@@ -72,10 +72,16 @@ def triplet_combine_kernel(kernel: Kernel) -> Optional[Kernel]:
 
 
 def _sqdist_matrix(a, b):
-    """[C, m] squared euclidean distances via the MXU contraction."""
+    """[C, m] squared euclidean distances via the MXU contraction.
+    Precision.HIGHEST: the default TPU matmul rounds operands to bf16,
+    whose ~1e-3 relative distance error flips indicator decisions on
+    near-ties — parity with the exact-f32 XLA tile scan requires the
+    full-precision (3-pass) MXU mode; the contraction is O(n^2 d) of
+    an O(n^3) computation, so the 3x matmul cost is invisible."""
     an = jnp.sum(a * a, axis=-1)
     bn = jnp.sum(b * b, axis=-1)
-    return an[:, None] + bn[None, :] - 2.0 * (a @ b.T)
+    cross = jnp.dot(a, b.T, precision=lax.Precision.HIGHEST)
+    return an[:, None] + bn[None, :] - 2.0 * cross
 
 
 def pallas_triplet_stats(
