@@ -119,6 +119,11 @@ def _compiled_triplet_trainer(cfg, mesh, n1, n2):
     def draw(key, n, m):
         return _draw(key, n, N, cfg.scheme, m=m)
 
+    # NOTE: step_fn/refresh/chunk_fn mirror pairwise_sgd._compiled_trainer
+    # token-for-token — the chunk-boundary key-fold discipline (refresh
+    # only at t > t0; r0 = t0 - t0 % n_r startup regather) lives in BOTH
+    # trainers. Any change to that contract must be applied to both;
+    # the chunking-invariance tests of each trainer pin the discipline.
     def step_fn(carry, t, t0, Xc, Xo):
         params, Ab, Bb = carry
         kt = fold(root, "step", t)
@@ -224,6 +229,17 @@ def train_triplet(
     )
 
 
+@functools.lru_cache(maxsize=1)
+def _eval_estimator():
+    """ONE cached evaluator: a fresh Estimator re-jits its programs on
+    every call (~1.6 s vs 0.08 s reused — a suite run makes ~500
+    evaluations). impl="pallas": the distance factorization serves the
+    complete statistic on TPU (XLA tiles elsewhere / custom kernels)."""
+    from tuplewise_tpu.estimators.estimator import Estimator
+
+    return Estimator("triplet_indicator", backend="jax", impl="pallas")
+
+
 def evaluate_triplet_accuracy(
     params, X_class, X_other, *, n_triplets: Optional[int] = None,
     seed: int = 0,
@@ -233,14 +249,10 @@ def evaluate_triplet_accuracy(
     constraints the learned metric satisfies. Complete by default
     (the Pallas distance factorization makes it cheap); pass
     n_triplets for the incomplete estimate at large n."""
-    from tuplewise_tpu.estimators.estimator import Estimator
-
     p = jax.tree.map(np.asarray, params)
     Ec = np.asarray(_embed(p, np.asarray(X_class)))
     Eo = np.asarray(_embed(p, np.asarray(X_other)))
-    # impl="pallas": the distance factorization serves the complete
-    # statistic on TPU (XLA tile scan elsewhere / for custom kernels)
-    est = Estimator("triplet_indicator", backend="jax", impl="pallas")
+    est = _eval_estimator()
     if n_triplets is None:
         return est.complete(Ec, Eo)
     return est.incomplete(Ec, Eo, n_pairs=n_triplets, seed=seed)
