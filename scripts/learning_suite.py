@@ -442,12 +442,13 @@ def stage_mesh8(q, platform):
             steps_timed=10 if q else 30,
         )
 
-    # Instrument-overlap cell [VERDICT r3 weak #6]: the SAME sweep
-    # cell measured by BOTH instruments — the vmapped sim trainer
-    # (the committed sweeps' engine) and the REAL shard_map mesh
-    # trainer, S seeds each, same fold chains (mesh seed = cfg.seed+s
-    # is sim replica s) — so the committed record shows the two
-    # agreeing per seed, not just in distribution.
+    # Instrument-overlap cell [VERDICT r3 weak #6]: ONE cell (the
+    # gauss data at N=8, 200 steps — a dedicated cell, not one of the
+    # committed sweep's) trained by BOTH instruments — the vmapped sim
+    # trainer (the committed sweeps' engine) and the REAL shard_map
+    # mesh trainer, S seeds each, same fold chains (mesh seed =
+    # cfg.seed+s is sim replica s) — so the committed record shows the
+    # two agreeing per seed, not just in distribution.
     import dataclasses as _dc
 
     import numpy as np
@@ -457,7 +458,7 @@ def stage_mesh8(q, platform):
     )
     from tuplewise_tpu.models.sim_learner import train_curves
 
-    data, scorer, p0, base, S, steps = _gauss_cells(q)
+    data, scorer, p0, base, *_ = _gauss_cells(q)
     Xp, Xn, Xp_te, Xn_te = data
     S_cell = 2 if q else 8
     for nr in ((1,) if q else (1, NEVER)):
@@ -493,7 +494,12 @@ def stage_mesh8(q, platform):
             "sim_final_auc": [round(v, 6) for v in sim_finals],
             "mesh_final_auc": [round(v, 6) for v in mesh_finals],
             "max_abs_delta": delta,
-            "wallclock_s": round(wc, 2), "platform": platform,
+            # honest label: each mesh seed is a fresh cfg -> a fresh
+            # compile, so this wall-clock is MOSTLY XLA compilation
+            # (the cell exists for parity, not timing; §6.4's rows
+            # carry the warmed throughput numbers)
+            "wallclock_incl_compile_s": round(wc, 2),
+            "platform": platform,
         }
         emit(rec, "learning_mesh_overlap.jsonl")
         log(f"overlap cell n_r={rec['n_r']}: max |sim-mesh| final-AUC "

@@ -56,6 +56,62 @@ class TestTripletSGD:
         )
         assert len(hist["test_acc"]) == 4
 
+    def test_checkpoint_resume_exact(self, rotated_clouds, tmp_path):
+        """Resume reproduces the straight run bit-for-bit (keys fold
+        from absolute steps), and config mismatches are refused —
+        the train_pairwise contract at degree 3 [SURVEY §5.5]."""
+        Xc_tr, Xo_tr, _, _ = rotated_clouds
+        p0 = init_embed(8, 2, seed=4)
+        cfg = TripletTrainConfig(
+            lr=0.1, steps=30, n_workers=4, repartition_every=8,
+            triplets_per_worker=256, seed=5, embed_dim=2,
+        )
+        p_straight, h_straight = train_triplet(p0, Xc_tr, Xo_tr, cfg)
+        ckpt = str(tmp_path / "triplet.npz")
+        # phase 1: first 10 steps, checkpointed
+        cfg10 = type(cfg)(**{**cfg.__dict__, "steps": 10})
+        train_triplet(p0, Xc_tr, Xo_tr, cfg10, checkpoint_path=ckpt)
+        # phase 2: resume to 30
+        p_resumed, h_resumed = train_triplet(
+            p0, Xc_tr, Xo_tr, cfg, checkpoint_path=ckpt
+        )
+        np.testing.assert_allclose(
+            p_resumed["W"], p_straight["W"], atol=1e-7
+        )
+        np.testing.assert_allclose(
+            h_resumed["loss"], h_straight["loss"], atol=1e-7
+        )
+        # config mismatch refuses to resume
+        bad = type(cfg)(**{**cfg.__dict__, "lr": 0.2})
+        with pytest.raises(ValueError):
+            train_triplet(p0, Xc_tr, Xo_tr, bad, checkpoint_path=ckpt)
+
+    def test_resume_preserves_eval_curve(self, rotated_clouds,
+                                         tmp_path):
+        """A resumed eval_every run carries the PRE-resume curve points
+        and evaluates at the same absolute steps as the straight run
+        (boundary realignment) — no silent truncation."""
+        Xc_tr, Xo_tr, Xc_te, Xo_te = rotated_clouds
+        p0 = init_embed(8, 2, seed=6)
+        cfg = TripletTrainConfig(
+            lr=0.1, steps=30, n_workers=4, repartition_every=8,
+            triplets_per_worker=256, seed=8, embed_dim=2,
+        )
+        kw = dict(eval_every=10, eval_data=(Xc_te, Xo_te))
+        _, h_straight = train_triplet(p0, Xc_tr, Xo_tr, cfg, **kw)
+        ckpt = str(tmp_path / "curve.npz")
+        cfg10 = type(cfg)(**{**cfg.__dict__, "steps": 10})
+        train_triplet(p0, Xc_tr, Xo_tr, cfg10, checkpoint_path=ckpt,
+                      **kw)
+        _, h_resumed = train_triplet(p0, Xc_tr, Xo_tr, cfg,
+                                     checkpoint_path=ckpt, **kw)
+        np.testing.assert_array_equal(
+            h_resumed["eval_steps"], h_straight["eval_steps"]
+        )
+        np.testing.assert_allclose(
+            h_resumed["test_acc"], h_straight["test_acc"], atol=1e-7
+        )
+
     def test_rejects_indicator_and_wrong_kind(self):
         with pytest.raises(ValueError, match="zero gradient"):
             train_triplet(

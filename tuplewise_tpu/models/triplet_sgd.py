@@ -166,13 +166,25 @@ def train_triplet(
     mesh=None,
     eval_every: Optional[int] = None,
     eval_data=None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
 ):
     """Distributed triplet SGD: anchors/positives from X_class (the
     target class), negatives from X_other. Returns (params, history);
     with ``eval_every`` + ``eval_data=(Xc_test, Xo_test)`` the history
     also carries the held-out triplet-accuracy curve (training runs in
     scan chunks between evaluations; keys fold from absolute step
-    indices, so the chunked trajectory IS the unchunked one)."""
+    indices, so the chunked trajectory IS the unchunked one).
+
+    Checkpoint/resume [SURVEY §5.5, same contract as train_pairwise]:
+    with ``checkpoint_path``, params + loss history + the accuracy
+    curve persist every ``checkpoint_every`` steps (default: at eval
+    boundaries, or once at the end without eval_every), and an
+    existing checkpoint resumes from its saved step EXACTLY (cfg.steps
+    may grow across resumes; every other field must match). Scan
+    chunks realign to ABSOLUTE eval/checkpoint boundaries, so a resume
+    from any saved step evaluates at the same steps as the straight
+    run."""
     kernel = get_kernel(cfg.kernel)
     if kernel.kind != "triplet":
         raise ValueError(
@@ -202,31 +214,81 @@ def train_triplet(
     run_chunk = _compiled_triplet_trainer(
         dataclasses.replace(cfg, steps=0), mesh, n1, n2
     )
-    if eval_every is None:
-        params, losses = run_chunk(
-            params, Xc, Xo, jnp.asarray(0, jnp.int32), cfg.steps
-        )
-        return (
-            jax.tree.map(np.asarray, params),
-            {"loss": np.asarray(losses)},
-        )
+
+    from tuplewise_tpu.utils.checkpoint import (
+        resume_progress, save_checkpoint,
+    )
+
+    start, ck = resume_progress(
+        checkpoint_path, dataclasses.asdict(cfg),
+        progress_key="steps", requested=cfg.steps,
+    )
     loss_parts, curve_steps, curve_acc = [], [], []
-    for t0 in range(0, cfg.steps, eval_every):
-        chunk = min(eval_every, cfg.steps - t0)
+    if ck is not None:
+        loss_parts = [ck["extra"]["loss"]]
+        # the curve survives the crash too — a resumed run must not
+        # silently truncate the committed accuracy history
+        curve_steps = list(ck["extra"].get("curve_steps", []))
+        curve_acc = list(ck["extra"].get("curve_acc", []))
+        params = jax.device_put(
+            {k: jnp.asarray(v, jnp.float32)
+             for k, v in ck["params"].items()},
+            replicated,
+        )
+
+    ckpt_every = checkpoint_every or eval_every
+
+    def next_boundary(t):
+        """Nearest ABSOLUTE eval/checkpoint boundary past t — chunks
+        realign after any resume, so eval steps match the straight
+        run's regardless of where the checkpoint landed."""
+        nxt = cfg.steps
+        for e in (eval_every, ckpt_every):
+            if e:
+                nxt = min(nxt, t - t % e + e)
+        return nxt
+
+    def save(step):
+        save_checkpoint(
+            checkpoint_path,
+            step=step,
+            params=jax.tree.map(np.asarray, params),
+            extra={
+                "loss": np.concatenate(loss_parts),
+                "curve_steps": np.asarray(curve_steps),
+                "curve_acc": np.asarray(curve_acc),
+            },
+            config=dataclasses.asdict(cfg),
+        )
+
+    t0 = start
+    while t0 < cfg.steps:
+        t1 = next_boundary(t0)
         params, losses = run_chunk(
-            params, Xc, Xo, jnp.asarray(t0, jnp.int32), chunk
+            params, Xc, Xo, jnp.asarray(t0, jnp.int32), t1 - t0
         )
         loss_parts.append(np.asarray(losses))
-        curve_steps.append(t0 + chunk)
-        curve_acc.append(evaluate_triplet_accuracy(params, *eval_data))
-    return (
-        jax.tree.map(np.asarray, params),
-        {
-            "loss": np.concatenate(loss_parts),
-            "eval_steps": np.asarray(curve_steps),
-            "test_acc": np.asarray(curve_acc),
-        },
-    )
+        if eval_every is not None and (
+            t1 % eval_every == 0 or t1 == cfg.steps
+        ):
+            curve_steps.append(t1)
+            curve_acc.append(
+                evaluate_triplet_accuracy(params, *eval_data)
+            )
+        if checkpoint_path and (
+            ckpt_every is None or t1 % ckpt_every == 0
+            or t1 == cfg.steps
+        ):
+            save(t1)
+        t0 = t1
+    hist = {
+        "loss": (np.concatenate(loss_parts) if loss_parts
+                 else np.empty(0, np.float32)),
+    }
+    if eval_every is not None:
+        hist["eval_steps"] = np.asarray(curve_steps)
+        hist["test_acc"] = np.asarray(curve_acc)
+    return jax.tree.map(np.asarray, params), hist
 
 
 @functools.lru_cache(maxsize=1)
