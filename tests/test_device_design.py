@@ -63,6 +63,83 @@ class TestDrawPairDesignDevice:
             draw_pair_design_device(jax.random.PRNGKey(0), 8, 8, 65,
                                     "swor")
 
+    def test_triplet_swor_distinct_off_diagonal(self):
+        from tuplewise_tpu.ops.device_design import (
+            draw_triplet_design_device,
+        )
+
+        i, j, k, w = jax.jit(
+            lambda kk: draw_triplet_design_device(kk, 20, 15, 900,
+                                                  "swor")
+        )(jax.random.PRNGKey(3))
+        m = np.asarray(w) > 0
+        iw, jw, kw = (np.asarray(x)[m] for x in (i, j, k))
+        assert float(jnp.sum(w)) == 900
+        assert not np.any(iw == jw)
+        assert len(set(zip(iw.tolist(), jw.tolist(), kw.tolist()))) == 900
+        assert kw.max() < 15 and iw.max() < 20 and jw.max() < 20
+
+    def test_triplet_swr_matches_legacy_trainer_draws(self):
+        """triplet_design='swr' reproduces the trainer's historical
+        split/randint sequence bit-for-bit — seed stability of the
+        committed learning_triplet rows."""
+        from tuplewise_tpu.ops.device_design import (
+            draw_triplet_design_device,
+        )
+
+        key = jax.random.PRNGKey(11)
+        ki, kj, kn = jax.random.split(key, 3)
+        i0 = jax.random.randint(ki, (64,), 0, 32)
+        j0 = jax.random.randint(kj, (64,), 0, 31)
+        j0 = jnp.where(j0 >= i0, j0 + 1, j0)
+        n0 = jax.random.randint(kn, (64,), 0, 48)
+        i1, j1, k1, w = draw_triplet_design_device(key, 32, 48, 64,
+                                                   "swr")
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(j0), np.asarray(j1))
+        np.testing.assert_array_equal(np.asarray(n0), np.asarray(k1))
+
+    @pytest.mark.parametrize("design", ["swr", "swor", "bernoulli"])
+    def test_triplet_conditional_variance_matches_exact_form(
+            self, design):
+        """Fixed data, indicator kernel: the triplet estimator's
+        variance over design redraws matches the fpc form with
+        s^2 = U(1-U) and G = n1(n1-1)n2 — the degree-3 version of the
+        pair-design audit."""
+        from tuplewise_tpu.estimators.variance import (
+            conditional_incomplete_variance,
+        )
+        from tuplewise_tpu.ops.device_design import (
+            draw_triplet_design_device,
+        )
+        from tuplewise_tpu.ops.kernels import get_kernel
+        from tuplewise_tpu.ops.pair_tiles import triplet_stats
+
+        k = get_kernel("triplet_indicator")
+        rng = np.random.default_rng(2)
+        X = jnp.asarray(rng.normal(size=(24, 3)).astype(np.float32))
+        Y = jnp.asarray(rng.normal(size=(20, 3)).astype(np.float32)
+                        + 0.4)
+        s, c = triplet_stats(k, X, Y, tile=8)
+        u = float(s) / float(c)
+        G, B = 24 * 23 * 20, 5_000
+
+        @jax.jit
+        def est(kk):
+            i, j, n, w = draw_triplet_design_device(kk, 24, 20, B,
+                                                    design)
+            vals = k.triplet_values(X[i], X[j], Y[n], jnp)
+            return jnp.sum(vals * w) / jnp.sum(w)
+
+        vals = np.asarray([
+            float(est(jax.random.PRNGKey(5000 + t))) for t in range(600)
+        ])
+        pred = conditional_incomplete_variance(
+            u * (1 - u), G, n_pairs=B, design=design
+        )
+        assert abs(vals.var(ddof=1) - pred) / pred < 0.25
+        assert abs(vals.mean() - u) < 5 * np.sqrt(pred / 600)
+
     @pytest.mark.parametrize("design", ["swr", "swor", "bernoulli"])
     def test_conditional_variance_matches_exact_form(self, design):
         """On FIXED scores, the weighted-mean estimator's variance over

@@ -53,6 +53,10 @@ class TripletTrainConfig:
     n_workers: int = 1
     repartition_every: int = 10
     triplets_per_worker: int = 4096   # B per worker per step
+    # per-worker triplet-budget design, drawn ON DEVICE per step
+    # (ops.device_design.draw_triplet_design_device — 3-key sort
+    # dedup; "swr" reproduces the legacy draws bit-for-bit)
+    triplet_design: str = "swr"
     scheme: str = "swor"
     seed: int = 0
 
@@ -91,15 +95,17 @@ def _compiled_triplet_trainer(cfg, mesh, n1, n2):
         kk = fold(key, "triplet_sample", linear_shard_index(axes))
 
         def loss_fn(p):
+            from tuplewise_tpu.ops.device_design import (
+                draw_triplet_design_device,
+            )
+
             ea = _embed(p, a[0])
             eb = _embed(p, b[0])
-            ki, kj, kn = jax.random.split(kk, 3)
-            i = jax.random.randint(ki, (B,), 0, m1)
-            j = jax.random.randint(kj, (B,), 0, m1 - 1)
-            j = jnp.where(j >= i, j + 1, j)      # i != j off-diagonal
-            n = jax.random.randint(kn, (B,), 0, m2)
+            i, j, n, w = draw_triplet_design_device(
+                kk, m1, m2, B, cfg.triplet_design
+            )
             vals = kernel.triplet_values(ea[i], ea[j], eb[n], jnp)
-            return jnp.mean(vals)
+            return jnp.sum(vals * w) / jnp.sum(w)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)

@@ -57,6 +57,74 @@ def _overdraw(grid: int, budget: int) -> int:
     return max(budget, int(math.ceil(k)))
 
 
+def _distinct_design(key, dims, budget: int, design: str, what: str):
+    """(cols, w): ``budget``-sized distinct-tuple draw from the product
+    grid prod(dims), in ENCODED coordinates (off-diagonal encodings are
+    the callers' business). ONE implementation of the
+    overdraw → multi-key-lex-sort dedup → uniform-subselect machinery
+    for every arity: `lax.sort(num_keys=len(dims))` generalizes the
+    dedup, so no grid linearization (int32 overflow) at any degree.
+
+    Key-split discipline (STABLE — committed rows reproduce these
+    draws): split(key, len(dims) + 2) = per-column keys, the bernoulli
+    size key, the subselection key, in that order.
+    """
+    import functools as _ft
+
+    grid = math.prod(dims)
+    if budget > 0.8 * grid:
+        # near-full-grid distinct sampling needs coupon-collector
+        # overdraw (K ~ G ln G) and the exactly-B contract degrades to
+        # a probabilistic shortfall; at these fractions the COMPLETE
+        # estimator is cheaper anyway — the host samplers
+        # (parallel.partition) cover budgets up to G.
+        raise ValueError(
+            f"cannot draw {budget} distinct {what} from a {grid} grid "
+            "on device (> 0.8 * grid); use the complete estimator or "
+            "the host sampler"
+        )
+    from tuplewise_tpu.parallel.partition import design_pad_len
+
+    L = min(design_pad_len(budget, design), grid)
+    K = _overdraw(grid, L)
+    *kcols, kb, kr = jax.random.split(key, len(dims) + 2)
+    cols = [jax.random.randint(kc, (K,), 0, d)
+            for kc, d in zip(kcols, dims)]
+    # pass 1: lexicographic sort marks first occurrences
+    cols_s = lax.sort(tuple(cols), num_keys=len(dims))
+    dup = _ft.reduce(
+        lambda a, c: a & (c == jnp.roll(c, 1)), cols_s,
+        jnp.ones(K, bool),
+    )
+    dup = dup.at[0].set(False)
+    # pass 2: uniform subselection — distinct entries sort by a random
+    # key, duplicates to the back (+inf), take the first L slots
+    rnd = jax.random.uniform(kr, (K,))
+    sel_key = jnp.where(dup, jnp.inf, rnd)
+    sorted2 = lax.sort((sel_key, *cols_s, dup), num_keys=1)
+    outs = [c[:L] for c in sorted2[1:-1]]
+    valid = ~sorted2[-1][:L]
+    if design == "swor":
+        take = jnp.asarray(L, jnp.float32)
+    else:
+        p = budget / grid
+        sd = math.sqrt(grid * p * (1.0 - p))
+        draw = jnp.round(
+            budget + sd * jax.random.normal(kb, (), jnp.float32)
+        )
+        take = jnp.clip(draw, 1.0, float(L))
+    w = (valid & (jnp.arange(L) < take)).astype(jnp.float32)
+    return outs, w
+
+
+def _check_design(design: str) -> None:
+    if design not in ("swr", "swor", "bernoulli"):
+        raise ValueError(
+            f"unknown sampling design {design!r}; "
+            "choose 'swr', 'swor', or 'bernoulli'"
+        )
+
+
 def draw_pair_design_device(
     key,
     n1: int,
@@ -76,54 +144,42 @@ def draw_pair_design_device(
     """
     from tuplewise_tpu.ops.pair_tiles import sample_pair_indices
 
-    grid = n1 * n2
     if design == "swr":
         i, j = sample_pair_indices(key, n1, n2 + (1 if one_sample else 0),
                                    n_pairs, one_sample)
         return i, j, jnp.ones(n_pairs, jnp.float32)
-    if design not in ("swor", "bernoulli"):
-        raise ValueError(
-            f"unknown sampling design {design!r}; "
-            "choose 'swr', 'swor', or 'bernoulli'"
-        )
-    if n_pairs > 0.8 * grid:
-        # near-full-grid distinct sampling needs coupon-collector
-        # overdraw (K ~ G ln G) and the exactly-B contract degrades to
-        # a probabilistic shortfall; at these fractions the COMPLETE
-        # estimator is cheaper anyway — the host sampler
-        # (parallel.partition.draw_pair_design) covers B up to G.
-        raise ValueError(
-            f"cannot draw {n_pairs} distinct tuples from a {grid} grid "
-            "on device (> 0.8 * grid); use the complete estimator or "
-            "the host sampler"
-        )
-    from tuplewise_tpu.parallel.partition import design_pad_len
-
-    L = min(design_pad_len(n_pairs, design), grid)
-    K = _overdraw(grid, L)
-    ki, kj, kk, kr = jax.random.split(key, 4)
-    i = jax.random.randint(ki, (K,), 0, n1)
-    j = jax.random.randint(kj, (K,), 0, n2)  # encoded (pre-shift) col
-    # pass 1: lexicographic sort on (i, j) marks first occurrences
-    i_s, j_s = lax.sort((i, j), num_keys=2)
-    dup = (i_s == jnp.roll(i_s, 1)) & (j_s == jnp.roll(j_s, 1))
-    dup = dup.at[0].set(False)
-    # pass 2: uniform subselection — distinct entries sort by a random
-    # key, duplicates to the back (+inf), take the first L slots
-    rnd = jax.random.uniform(kr, (K,))
-    sel_key = jnp.where(dup, jnp.inf, rnd)
-    _, i_f, j_f, dup_f = lax.sort((sel_key, i_s, j_s, dup), num_keys=1)
-    i_f, j_f, valid = i_f[:L], j_f[:L], ~dup_f[:L]
-    if design == "swor":
-        take = jnp.asarray(L, jnp.float32)
-    else:
-        p = n_pairs / grid
-        sd = math.sqrt(grid * p * (1.0 - p))
-        draw = jnp.round(
-            n_pairs + sd * jax.random.normal(kk, (), jnp.float32)
-        )
-        take = jnp.clip(draw, 1.0, float(L))
-    w = (valid & (jnp.arange(L) < take)).astype(jnp.float32)
+    _check_design(design)
+    (i_f, j_f), w = _distinct_design(
+        key, (n1, n2), n_pairs, design, "tuples"
+    )
     if one_sample:
         j_f = jnp.where(j_f >= i_f, j_f + 1, j_f)
     return i_f, j_f, w
+
+
+def draw_triplet_design_device(
+    key,
+    n1: int,
+    n2: int,
+    n_triplets: int,
+    design: str = "swr",
+):
+    """(i, j, k, w) sampling the off-diagonal triple grid
+    {i != j in [0, n1)} x [0, n2) under ``design`` — the degree-3
+    mirror of draw_pair_design_device for the triplet trainer's
+    per-step budgets [SURVEY §1.2 item 4 at degree 3]. The positive
+    index j is encoded off-diagonal (n1 - 1 columns) during dedup and
+    shifted past i on return, exactly like the host sampler."""
+    if design == "swr":
+        ki, kj, kk = jax.random.split(key, 3)
+        i = jax.random.randint(ki, (n_triplets,), 0, n1)
+        j = jax.random.randint(kj, (n_triplets,), 0, n1 - 1)
+        j = jnp.where(j >= i, j + 1, j)
+        k = jax.random.randint(kk, (n_triplets,), 0, n2)
+        return i, j, k, jnp.ones(n_triplets, jnp.float32)
+    _check_design(design)
+    (i_f, j_f, k_f), w = _distinct_design(
+        key, (n1, n1 - 1, n2), n_triplets, design, "triples"
+    )
+    j_f = jnp.where(j_f >= i_f, j_f + 1, j_f)
+    return i_f, j_f, k_f, w
