@@ -112,6 +112,106 @@ class TestEndToEnd:
         assert evaluate_auc(scorer, p1, Xp, Xn) > 0.75
 
 
+class TestLossFreeSteps:
+    """cfg.loss_every > 1 [VERDICT r4 next #1]: the trajectory is
+    IDENTICAL to per-step loss recording (gradients unchanged); only
+    the loss history changes (NaN off the boundary)."""
+
+    def test_trajectory_identical_and_nan_pattern(self, gauss):
+        Xp, Xn = gauss
+        Xp, Xn = Xp[:400], Xn[:400]
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=4)
+        base = TrainConfig(kernel="hinge", lr=0.3, steps=12,
+                           n_workers=4, repartition_every=5, tile=128)
+        import dataclasses
+        p_ref, h_ref = train_pairwise(scorer, dict(p0), Xp, Xn, base)
+        p_lf, h_lf = train_pairwise(
+            scorer, dict(p0), Xp, Xn,
+            dataclasses.replace(base, loss_every=3),
+        )
+        np.testing.assert_allclose(p_ref["w"], p_lf["w"],
+                                   rtol=1e-6, atol=1e-7)
+        rec = np.arange(12) % 3 == 0
+        assert np.isfinite(h_lf["loss"][rec]).all()
+        assert np.isnan(h_lf["loss"][~rec]).all()
+        np.testing.assert_allclose(h_ref["loss"][rec],
+                                   h_lf["loss"][rec], rtol=1e-6)
+
+    def test_chunked_run_reproduces_unchunked(self, gauss, tmp_path):
+        """loss_every composes with checkpoint chunking: record is a
+        function of the ABSOLUTE step, so chunk boundaries cannot shift
+        which steps record."""
+        Xp, Xn = gauss
+        Xp, Xn = Xp[:320], Xn[:320]
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=5)
+        import dataclasses
+        cfg = TrainConfig(kernel="logistic", lr=0.3, steps=10,
+                          n_workers=4, repartition_every=4, tile=128,
+                          loss_every=4)
+        p_a, h_a = train_pairwise(scorer, dict(p0), Xp, Xn, cfg)
+        p_b, h_b = train_pairwise(
+            scorer, dict(p0), Xp, Xn, cfg,
+            checkpoint_path=str(tmp_path / "ck.npz"),
+            checkpoint_every=3,
+        )
+        np.testing.assert_array_equal(p_a["w"], p_b["w"])
+        np.testing.assert_array_equal(
+            np.isnan(h_a["loss"]), np.isnan(h_b["loss"])
+        )
+        m = np.isfinite(h_a["loss"])
+        np.testing.assert_array_equal(h_a["loss"][m], h_b["loss"][m])
+
+    def test_budgeted_path_masks_only(self, gauss):
+        """pairs_per_worker + loss_every: gradient path unchanged
+        (loss is a byproduct there); history masking still applies."""
+        Xp, Xn = gauss
+        Xp, Xn = Xp[:256], Xn[:256]
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=6)
+        import dataclasses
+        base = TrainConfig(kernel="hinge", lr=0.2, steps=8,
+                           n_workers=4, repartition_every=4,
+                           pairs_per_worker=64, tile=128)
+        p_ref, h_ref = train_pairwise(scorer, dict(p0), Xp, Xn, base)
+        p_lf, h_lf = train_pairwise(
+            scorer, dict(p0), Xp, Xn,
+            dataclasses.replace(base, loss_every=2),
+        )
+        np.testing.assert_array_equal(p_ref["w"], p_lf["w"])
+        rec = np.arange(8) % 2 == 0
+        np.testing.assert_allclose(h_ref["loss"][rec], h_lf["loss"][rec])
+        assert np.isnan(h_lf["loss"][~rec]).all()
+
+    def test_sim_trainer_matches_mesh_with_loss_every(self, gauss):
+        """The sim instrument honors loss_every too: same NaN record,
+        same trajectory as its own loss_every=1 run."""
+        import dataclasses
+
+        from tuplewise_tpu.models.sim_learner import train_curves
+
+        Xp, Xn = gauss
+        Xp, Xn = Xp[:200], Xn[:200]
+        scorer = LinearScorer(dim=5)
+        p0 = scorer.init(seed=8)
+        base = TrainConfig(kernel="hinge", lr=0.2, steps=6,
+                           n_workers=4, repartition_every=3, tile=128)
+        out_ref = train_curves(scorer, p0, Xp, Xn, Xp[:50], Xn[:50],
+                               base, n_seeds=2, eval_every=6)
+        out_lf = train_curves(scorer, p0, Xp, Xn, Xp[:50], Xn[:50],
+                              dataclasses.replace(base, loss_every=2),
+                              n_seeds=2, eval_every=6)
+        np.testing.assert_array_equal(
+            np.asarray(out_ref["final_params"]["w"]),
+            np.asarray(out_lf["final_params"]["w"]),
+        )
+        rec = np.arange(6) % 2 == 0
+        np.testing.assert_allclose(out_ref["loss"][:, rec],
+                                   out_lf["loss"][:, rec])
+        assert np.isnan(out_lf["loss"][:, ~rec]).all()
+
+
 class TestAnalyticPairGradient:
     """diff_pair_mean's custom VJP (streamed g' row/col reductions)
     must match autodiff of the dense pair mean exactly."""
@@ -221,6 +321,63 @@ class TestAnalyticPairGradient:
         )(s1, s2)
         np.testing.assert_allclose(g1d, g1p, atol=1e-7)
         np.testing.assert_allclose(g2d, g2p, atol=1e-7)
+
+    @pytest.mark.parametrize("kname", ["hinge", "logistic"])
+    def test_loss_free_vjp_matches_dense_autodiff(self, kname):
+        """diff_pair_mean_loss_free: NaN value, gradient identical to
+        diff_pair_mean's [VERDICT r4 next #1]."""
+        import jax
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pair_tiles
+        from tuplewise_tpu.ops.kernels import get_kernel
+
+        k = get_kernel(kname)
+        rng = np.random.default_rng(13)
+        s1 = jnp.asarray(rng.standard_normal(70), jnp.float32)
+        s2 = jnp.asarray(rng.standard_normal(90), jnp.float32)
+
+        def dense(a, b):
+            return jnp.mean(k.diff(a[:, None] - b[None, :], jnp))
+
+        g1d, g2d = jax.grad(dense, argnums=(0, 1))(s1, s2)
+        v, (g1, g2) = jax.value_and_grad(
+            lambda a, b: pair_tiles.diff_pair_mean_loss_free(
+                k, a, b, 32, 32
+            ),
+            argnums=(0, 1),
+        )(s1, s2)
+        assert np.isnan(float(v))
+        np.testing.assert_allclose(g1d, g1, atol=1e-7)
+        np.testing.assert_allclose(g2d, g2, atol=1e-7)
+
+    def test_loss_free_vjp_pallas_interpret(self, monkeypatch):
+        """The loss-free forward routes to the one-pass Pallas grad
+        kernel when Pallas serves; gradients still match dense."""
+        import jax
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pair_tiles
+        from tuplewise_tpu.ops.kernels import get_kernel
+
+        monkeypatch.setenv("TUPLEWISE_HARNESS_PALLAS", "interpret")
+        k = get_kernel("hinge")
+        rng = np.random.default_rng(17)
+        s1 = jnp.asarray(rng.standard_normal(130), jnp.float32)
+        s2 = jnp.asarray(rng.standard_normal(70), jnp.float32)
+
+        def dense(a, b):
+            return jnp.mean(k.diff(a[:, None] - b[None, :], jnp))
+
+        g1d, g2d = jax.grad(dense, argnums=(0, 1))(s1, s2)
+        g1, g2 = jax.grad(
+            lambda a, b: pair_tiles.diff_pair_mean_loss_free(
+                k, a, b, 32, 32
+            ),
+            argnums=(0, 1),
+        )(s1, s2)
+        np.testing.assert_allclose(g1d, g1, atol=1e-7)
+        np.testing.assert_allclose(g2d, g2, atol=1e-7)
 
     def test_learner_uses_it_and_still_learns(self):
         """End-to-end: hinge training (analytic path) still lifts AUC."""

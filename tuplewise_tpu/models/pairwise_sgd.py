@@ -62,6 +62,16 @@ class TrainConfig:
     scheme: str = "swor"
     seed: int = 0
     tile: int = 512
+    # record the surrogate loss every k steps [VERDICT r4 next #1]: on
+    # non-recorded steps the full-pair path dispatches the GRAD-ONLY
+    # Pallas kernel (one g'-pass; the fused loss+grad kernel's g-body
+    # costs ~35% of a step for a value the scan would discard) and the
+    # history carries NaN there. Gradients are identical either way —
+    # loss_every changes what is RECORDED, never the trajectory. A
+    # value >= steps records only step 0 ("loss-free" training); the
+    # budgeted path (pairs_per_worker) computes its loss as a free
+    # byproduct of the gradient, so only the NaN masking applies there.
+    loss_every: int = 1
 
 
 # --------------------------------------------------------------------- #
@@ -94,14 +104,20 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
     def draw_blocks(key, n, m):
         return _draw(key, n, N, cfg.scheme, m=m)
 
-    def sgd_body(params, a, b, key):
+    def sgd_body(params, a, b, key, record):
         """One worker's step: local pair gradient, pmean, update.
-        a, b: [1, m, d] local blocks."""
+        a, b: [1, m, d] local blocks; record: scalar bool — whether
+        this step's loss is recorded (cfg.loss_every boundary)."""
 
-        def loss_fn(p):
+        def loss_fn(p, loss_free=False):
             s1 = scorer.apply(p, a[0], jnp)
             s2 = scorer.apply(p, b[0], jnp)
             if cfg.pairs_per_worker is None:
+                if loss_free:
+                    # grad-only pass: NaN value, identical gradient
+                    return pair_tiles.diff_pair_mean_loss_free(
+                        kernel, s1, s2, cfg.tile, cfg.tile
+                    )
                 # analytic streamed g' backward when the surrogate
                 # declares one (hinge/logistic do): ~100x the
                 # autodiff-through-tiles gradient at n=10^5
@@ -123,7 +139,23 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
             vals = kernel.diff(s1[i] - s2[j], jnp)
             return jnp.sum(vals * w) / jnp.sum(w)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if cfg.pairs_per_worker is None and cfg.loss_every != 1:
+            # both branches traced once; each step executes ONE grid
+            # pass — fused loss+grad on recorded steps, g'-only between
+            loss, grads = lax.cond(
+                record,
+                lambda p: jax.value_and_grad(loss_fn)(p),
+                lambda p: jax.value_and_grad(
+                    lambda q: loss_fn(q, loss_free=True)
+                )(p),
+                params,
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            if cfg.loss_every != 1:
+                # budgeted path: the loss is a free byproduct of the
+                # gradient — only the recording mask applies
+                loss = jnp.where(record, loss, jnp.nan)
         grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)
         loss = lax.pmean(loss, axes)
         new_params = jax.tree.map(
@@ -134,7 +166,7 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
     sgd_smap = jax.shard_map(
         sgd_body,
         mesh=mesh,
-        in_specs=(P(), P(axes), P(axes), P()),
+        in_specs=(P(), P(axes), P(axes), P(), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -160,7 +192,9 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
             (t % cfg.repartition_every == 0) & (t > t0),
             refresh, lambda _: (Ab, Bb), None,
         )
-        params, loss = sgd_smap(params, Ab, Bb, kt)
+        params, loss = sgd_smap(
+            params, Ab, Bb, kt, t % cfg.loss_every == 0
+        )
         return (params, Ab, Bb), loss
 
     def chunk_fn(params, Xp, Xn, t0, chunk_len):
@@ -194,8 +228,10 @@ def train_pairwise(
     """Distributed pairwise SGD over a device mesh.
 
     Returns (params, history) where history["loss"] is the per-step
-    psum-averaged surrogate loss. Runs on any mesh size >= 1 (a 1-chip
-    mesh reproduces serial SGD over the full pair set).
+    psum-averaged surrogate loss (NaN on steps cfg.loss_every skips —
+    the trajectory is unchanged, only the recording). Runs on any mesh
+    size >= 1 (a 1-chip mesh reproduces serial SGD over the full pair
+    set).
 
     Checkpoint/resume [SURVEY §5.5]: with ``checkpoint_path``, training
     runs in scan chunks of ``checkpoint_every`` steps (default: one
@@ -216,6 +252,16 @@ def train_pairwise(
             "the AUC indicator has zero gradient almost everywhere; train "
             "with a surrogate ('logistic' or 'hinge') and evaluate with "
             "evaluate_auc"
+        )
+    if (cfg.loss_every != 1 and cfg.pairs_per_worker is None
+            and kernel.diff_grad_fn is None):
+        # lax.cond traces BOTH branches, and the loss-free branch has no
+        # autodiff fallback (grad-only needs the analytic g'): fail here
+        # with the reason, not deep inside the jitted scan
+        raise ValueError(
+            f"loss_every={cfg.loss_every} needs an analytic gradient "
+            f"(kernel {kernel.name!r} has no diff_grad_fn); use "
+            "loss_every=1 or a kernel with diff_grad_fn"
         )
     mesh = mesh if mesh is not None else make_mesh(cfg.n_workers)
     N = int(np.prod(mesh.devices.shape))

@@ -45,6 +45,15 @@ from tuplewise_tpu.utils.rng import fold, root_key
 NEVER = 1 << 30
 
 
+def _last_finite_loss_mean(loss) -> float | None:
+    """Seed-mean of the last step whose loss was RECORDED (loss_every
+    masks the rest to NaN); None when no step recorded."""
+    finite = np.where(np.isfinite(loss).all(axis=0))[0]
+    if finite.size == 0:
+        return None
+    return float(loss[:, finite[-1]].mean())
+
+
 def curve_record(cfg, out, n_seeds: int) -> dict:
     """Summary row for one :func:`train_curves` cell — the ONE copy of
     the row schema shared by scripts/learning_suite.py and the CLI
@@ -82,7 +91,10 @@ def curve_record(cfg, out, n_seeds: int) -> dict:
         "final_auc_mean": float(fin.mean()),
         "final_auc_se": final_se,
         "final_auc_sd": final_sd,
-        "loss_final_mean": float(out["loss"][:, -1].mean()),
+        # last RECORDED loss: with cfg.loss_every > 1 trailing steps
+        # carry NaN, and a NaN here would be the invalid-JSON case the
+        # docstring forbids
+        "loss_final_mean": _last_finite_loss_mean(out["loss"]),
     }
 
 
@@ -145,7 +157,13 @@ def _compiled_sim_trainer(scorer, cfg, n1, n2):
         )(params, Ab, Bb, keys)
         g = jax.tree.map(lambda x: jnp.mean(x, axis=0), grads)
         params = jax.tree.map(lambda p, gg: p - cfg.lr * gg, params, g)
-        return (params, Ab, Bb), jnp.mean(losses)
+        loss = jnp.mean(losses)
+        if cfg.loss_every != 1:
+            # history parity with the mesh trainer's loss_every
+            # semantics: the dense-grid loss is free here, but the
+            # RECORD must match (NaN off the cfg.loss_every boundary)
+            loss = jnp.where(t % cfg.loss_every == 0, loss, jnp.nan)
+        return (params, Ab, Bb), loss
 
     def chunk_one_seed(params, Xp, Xn, root, t0, chunk_len):
         # regather blocks as of the latest repartition boundary, with
