@@ -218,6 +218,96 @@ def stage_designs(q, platform):
                 )
 
 
+def stage_prod(q, platform):
+    """Production-size budgeted sweep [VERDICT r4 next #5]: the O(B)
+    budgeted path (device pair designs, no per-worker grid) frees the
+    sim instrument from the toy-m regime, so the committed record gets
+    a proper-m cell WITH error bars: n = 16384/class, S = 16 seeds,
+    n_r in {1, never}, at N in {8, 64} workers (N=64 puts the
+    per-worker block at 256/class — the visible-trade-off regime at
+    production data size). A same-shape instrument-overlap cell then
+    trains the N=8 config on BOTH instruments (vmapped sim vs real
+    shard_map mesh, seed-aligned) so the at-scale sweep is backed by
+    the same per-seed agreement evidence as the toy cells."""
+    import numpy as np
+
+    from tuplewise_tpu.data import make_gaussian_splits
+    from tuplewise_tpu.models.pairwise_sgd import (
+        TrainConfig, evaluate_auc, train_pairwise,
+    )
+    from tuplewise_tpu.models.scorers import LinearScorer
+
+    n = 1024 if q else 16384
+    S = 4 if q else 16
+    steps = 40 if q else 500
+    data = make_gaussian_splits(n, 2000 if q else 20000, dim=10,
+                                separation=0.8, seed=0)
+    scorer = LinearScorer(dim=10)
+    p0 = scorer.init(0)
+    base = TrainConfig(kernel="hinge", lr=0.3, steps=steps, seed=1000,
+                       pairs_per_worker=256)
+    for N in (8, 64):
+        for nr in (1, NEVER):
+            run_config(
+                scorer, p0, data,
+                dataclasses.replace(base, n_workers=N,
+                                    repartition_every=nr),
+                n_seeds=S, eval_every=steps // 20 or 1,
+                dataset="gaussians",
+                out_name="learning_prod.jsonl", platform=platform,
+            )
+
+    # instrument overlap at the SAME shape (N=8): per-seed agreement
+    # between the sim sweep engine and the real mesh trainer
+    import dataclasses as _dc
+
+    from tuplewise_tpu.models.sim_learner import train_curves
+
+    Xp, Xn, Xp_te, Xn_te = data
+    S_cell = 2 if q else 8
+    for nr in ((1,) if q else (1, NEVER)):
+        cfg = _dc.replace(base, n_workers=8, repartition_every=nr,
+                          steps=40 if q else 200)
+        t0 = time.perf_counter()
+        out = train_curves(scorer, p0, Xp, Xn, Xp_te, Xn_te, cfg,
+                           n_seeds=S_cell, eval_every=10**9)
+        sim_finals = [
+            float(evaluate_auc(
+                scorer,
+                {k: np.asarray(v)[s] for k, v in
+                 out["final_params"].items()},
+                Xp_te, Xn_te))
+            for s in range(S_cell)
+        ]
+        mesh_finals = []
+        for s in range(S_cell):
+            p_s, _ = train_pairwise(
+                scorer, p0, Xp, Xn, _dc.replace(cfg, seed=cfg.seed + s)
+            )
+            mesh_finals.append(
+                float(evaluate_auc(scorer, p_s, Xp_te, Xn_te))
+            )
+        wc = time.perf_counter() - t0
+        delta = float(np.max(np.abs(
+            np.asarray(sim_finals) - np.asarray(mesh_finals)
+        )))
+        emit({
+            "cell": "instrument_overlap_prod", "n_workers": 8,
+            "n_train_per_class": n,
+            "pairs_per_worker": base.pairs_per_worker,
+            "n_r": None if nr >= NEVER else nr, "steps": cfg.steps,
+            "n_seeds": S_cell,
+            "sim_final_auc": [round(v, 6) for v in sim_finals],
+            "mesh_final_auc": [round(v, 6) for v in mesh_finals],
+            "max_abs_delta": delta,
+            "wallclock_incl_compile_s": round(wc, 2),
+            "platform": platform,
+        }, "learning_prod.jsonl")
+        log(f"prod overlap n_r={None if nr >= NEVER else nr}: "
+            f"max |sim-mesh| = {delta:.2e} over {S_cell} seeds "
+            f"({wc:.1f}s)")
+
+
 def stage_triplet(q, platform):
     """Degree-3 metric learning [VERDICT r3 next #9]: the triplet-hinge
     learner (models.triplet_sgd) trained through a k=2 embedding
@@ -231,10 +321,18 @@ def stage_triplet(q, platform):
       the curve shows recovery through the bottleneck).
 
     Repartition schedule sweep n_r in {1, 25, never}, S seeds each.
+
+    r5 adds the NONLINEAR-embedding cell [VERDICT r4 next #9]: a
+    radial task (inner shell vs outer shell, Bayes ceiling 1.0 by
+    construction) trained with the linear embedding AND the MLP
+    embedder through the SAME budgeted path — a linear projection
+    cannot separate radii, so the cell shows the plugin discipline
+    closing the Bayes-ceiling gap.
     """
     import numpy as np
 
     from tuplewise_tpu.data import load_mnist_embeddings, make_gaussians
+    from tuplewise_tpu.models.scorers import LinearEmbed, MLPEmbed
     from tuplewise_tpu.models.triplet_sgd import (
         TripletTrainConfig, evaluate_triplet_accuracy, init_embed,
         train_triplet,
@@ -309,10 +407,81 @@ def stage_triplet(q, platform):
                 ) if S > 1 else None,
                 "wallclock_s": round(wc, 2), "platform": platform,
             }
+            rec["embedder"] = "linear"
             emit(rec, "learning_triplet.jsonl")
             log(f"triplet {task} n_r={rec['n_r']} "
                 f"final={rec['final_acc_mean']:.5f} "
                 f"(init {rec['acc_init_mean']:.5f}) ({wc:.1f}s)")
+
+    # ---- nonlinear-embedding cell [VERDICT r4 next #9] -------------- #
+    def radial_data(seed):
+        """Inner shell (class) vs outer shell (others) in d=8: radii
+        are disjoint, so the Bayes triplet accuracy is 1.0 — but no
+        LINEAR projection separates radii, so the linear embedding
+        plateaus well below the ceiling and the MLP must close it."""
+        rng = np.random.default_rng(seed)
+        d, n = 8, (240 if q else 2_000)
+
+        def shell(m, r_lo, r_hi):
+            v = rng.standard_normal((m, d))
+            v /= np.linalg.norm(v, axis=1, keepdims=True)
+            r = rng.uniform(r_lo, r_hi, size=(m, 1))
+            return (v * r).astype(np.float32)
+
+        X = shell(n, 0.5, 1.0)
+        Y = shell(3 * n, 1.8, 2.6)
+        Xc_tr, Xc_te = split(X, 0.75, rng)
+        Xo_tr, Xo_te = split(Y, 0.75, rng)
+        return Xc_tr, Xo_tr, Xc_te, Xo_te
+
+    # the MLP needs a longer horizon: at 300 steps it is still climbing
+    # through the linear plateau (~0.85); 800 steps brings it near the
+    # 1.0 ceiling while linear cannot move
+    r_steps = steps if q else 800
+    for name in ("linear", "mlp"):
+        accs, curves, acc0s = [], [], []
+        t0 = time.perf_counter()
+        for s in range(S):
+            Xc_tr, Xo_tr, Xc_te, Xo_te = radial_data(s)
+            emb = (LinearEmbed(dim=8, embed_dim=2) if name == "linear"
+                   else MLPEmbed(dim=8, hidden=32, embed_dim=2))
+            p0 = emb.init(seed=s)
+            acc0s.append(evaluate_triplet_accuracy(
+                p0, Xc_te, Xo_te, embedder=emb))
+            cfg = TripletTrainConfig(
+                lr=0.3, steps=r_steps, n_workers=N,
+                repartition_every=1,
+                triplets_per_worker=512 if q else 4_096,
+                seed=1_000 + s, embed_dim=2,
+            )
+            _, hist = train_triplet(
+                p0, Xc_tr, Xo_tr, cfg,
+                eval_every=max(r_steps // 10, 1),
+                eval_data=(Xc_te, Xo_te), embedder=emb,
+            )
+            curves.append(hist["test_acc"])
+            accs.append(float(hist["test_acc"][-1]))
+        wc = time.perf_counter() - t0
+        accs = np.asarray(accs)
+        rec = {
+            "task": "radial", "embedder": name, "embed_dim": 2,
+            "n_workers": N, "n_r": 1, "repartition_every": 1,
+            "steps": r_steps,
+            "triplets_per_worker": 512 if q else 4_096, "n_seeds": S,
+            "bayes_ceiling": 1.0,
+            "acc_init_mean": round(float(np.mean(acc0s)), 6),
+            "acc_curve_mean": np.round(
+                np.mean(np.stack(curves), axis=0), 6).tolist(),
+            "final_acc_mean": round(float(accs.mean()), 6),
+            "final_acc_se": round(
+                float(accs.std(ddof=1) / np.sqrt(S)), 6
+            ) if S > 1 else None,
+            "wallclock_s": round(wc, 2), "platform": platform,
+        }
+        emit(rec, "learning_triplet.jsonl")
+        log(f"triplet radial embedder={name} "
+            f"final={rec['final_acc_mean']:.5f} "
+            f"(init {rec['acc_init_mean']:.5f}) ({wc:.1f}s)")
 
 
 def stage_gauss_chip(q, platform):
@@ -652,16 +821,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--stages",
-                    default="gauss,adult,designs,triplet,mesh8,figs",
-                    help="comma list: gauss,adult,designs,triplet,mesh8,"
-                         "chip,gauss-chip,trace,figs")
+                    default="gauss,adult,designs,prod,triplet,mesh8,figs",
+                    help="comma list: gauss,adult,designs,prod,triplet,"
+                         "mesh8,chip,gauss-chip,trace,figs")
     args = ap.parse_args()
     stages = set(args.stages.split(","))
-    known = {"gauss", "adult", "designs", "triplet", "mesh8", "chip",
-             "gauss-chip", "trace", "figs"}
+    known = {"gauss", "adult", "designs", "prod", "triplet", "mesh8",
+             "chip", "gauss-chip", "trace", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}")
-    _cpu_stages = {"gauss", "adult", "designs", "triplet", "mesh8"}
+    _cpu_stages = {"gauss", "adult", "designs", "prod", "triplet",
+                   "mesh8"}
     if stages & {"chip", "gauss-chip", "trace"} and stages & _cpu_stages:
         ap.error("run --stages chip in its own invocation: the platform "
                  "(TPU vs forced-CPU) is process-global")
@@ -669,7 +839,7 @@ def main():
     QUICK = args.quick
     os.makedirs(RESULTS, exist_ok=True)
 
-    if stages & {"gauss", "adult", "designs", "triplet", "mesh8"}:
+    if stages & _cpu_stages:
         # sim sweeps + virtual mesh run on the forced-CPU platform (8
         # virtual devices for mesh8); same conftest dance as tests/
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -693,6 +863,8 @@ def main():
         stage_adult(args.quick, platform)
     if "designs" in stages:
         stage_designs(args.quick, platform)
+    if "prod" in stages:
+        stage_prod(args.quick, platform)
     if "triplet" in stages:
         stage_triplet(args.quick, platform)
     if "mesh8" in stages:

@@ -232,6 +232,24 @@ def main():
                         baseC, scheme="incomplete", n_pairs=B,
                         design=design),
                     "designs_conditional.jsonl", chunk=None if q else 250)
+        # Degree-3 conditional rows [VERDICT r4 next #3]: same frozen-
+        # data audit, triplet grid G = n1(n1-1)n2 = 62,400 at n=40/class
+        # (only B/G sets the fpc factor, so the small grid keeps the
+        # host-designed index blocks at [chunk, ~B]); z-checked against
+        # the EXACT s^2 = U(1-U) forms by scripts/stat_check.py.
+        GT = 40 * 39 * 40
+        baseT = VarianceConfig(
+            kernel="triplet_indicator", n_pos=40, n_neg=40, dim=3,
+            separation=1.0, n_workers=2, n_reps=mC, fix_data=True,
+        )
+        for design in ("swr", "swor", "bernoulli"):
+            for B in (GT // 10, GT // 2):
+                if q and B > GT // 10:
+                    continue
+                run(dataclasses.replace(
+                        baseT, scheme="incomplete", n_pairs=B,
+                        design=design),
+                    "designs_conditional.jsonl", chunk=None if q else 250)
 
     if "mesh" in stages:
         # the DISTRIBUTED estimator on the real chip: mesh of 1, ring

@@ -47,24 +47,40 @@ def fixed_row_targets(cfg: dict):
     """(exact mean, exact conditional variance) for a fix_data=True
     incomplete row: the frozen dataset is reconstructed bit-identically
     (harness.variance.fixed_dataset), the complete U computed exactly
-    (O(n log n) midranks), and the conditional design form follows from
-    s^2 = U(1-U) — NO plug-in anywhere, the strongest audit in this
-    file. Returns None when the row isn't auditable this way."""
-    if (cfg.get("scheme") != "incomplete" or cfg.get("backend") != "jax"
-            or cfg.get("kernel") != "auc" or cfg.get("dim") != 1):
+    (O(n log n) midranks for AUC; the full triplet reduction for
+    degree 3 [VERDICT r4 next #3]), and the conditional design form
+    follows from s^2 = U(1-U) — NO plug-in anywhere, the strongest
+    audit in this file. Grid size G is n1*n2 for pairs and n1(n1-1)n2
+    for triplets. Returns None when the row isn't auditable this way."""
+    if cfg.get("scheme") != "incomplete" or cfg.get("backend") != "jax":
         return None
-    key = (cfg["seed"], cfg["n_pos"], cfg["n_neg"], cfg["separation"])
+    is_pair = cfg.get("kernel") == "auc" and cfg.get("dim") == 1
+    is_triplet = cfg.get("kernel") == "triplet_indicator"
+    if not (is_pair or is_triplet):
+        return None
+    n1, n2 = cfg["n_pos"], cfg["n_neg"]
+    key = (cfg["kernel"], cfg["seed"], n1, n2, cfg.get("dim"),
+           cfg["separation"])
     if key not in _FIXED:
         from tuplewise_tpu.harness.variance import (
             VarianceConfig, fixed_dataset,
         )
-        from tuplewise_tpu.models.metrics import auc_score
 
-        s1, s2 = fixed_dataset(VarianceConfig(**cfg))
-        _FIXED[key] = auc_score(s1, s2)
+        A, B = fixed_dataset(VarianceConfig(**cfg))
+        if is_pair:
+            from tuplewise_tpu.models.metrics import auc_score
+
+            _FIXED[key] = auc_score(A, B)
+        else:
+            from tuplewise_tpu.estimators.estimator import Estimator
+
+            _FIXED[key] = Estimator(
+                cfg["kernel"], backend="numpy"
+            ).complete(A, B)
     u = _FIXED[key]
+    grid = n1 * (n1 - 1) * n2 if is_triplet else n1 * n2
     pred = conditional_incomplete_variance(
-        u * (1.0 - u), cfg["n_pos"] * cfg["n_neg"],
+        u * (1.0 - u), grid,
         n_pairs=cfg["n_pairs"], design=cfg.get("design", "swr"),
     )
     return u, pred
@@ -121,7 +137,12 @@ def main(out: str | None = None) -> int:
             if (not isinstance(cfg, dict) or not M or M < 8
                     or "scheme" not in cfg or "separation" not in cfg):
                 continue
-            if cfg.get("kernel") != "auc" or cfg.get("dim") != 1:
+            aud_pair = cfg.get("kernel") == "auc" and cfg.get("dim") == 1
+            # fix_data triplet rows audit against their own EXACT
+            # conditional forms (fixed_row_targets) [VERDICT r4 next #3]
+            aud_tri = (cfg.get("kernel") == "triplet_indicator"
+                       and cfg.get("fix_data"))
+            if not (aud_pair or aud_tri):
                 # only the 1-D AUC family has the Φ(sep/√2) population
                 # mean and zeta closed forms; scatter/triplet mesh rows
                 # are validated by their own tests, not this audit

@@ -91,9 +91,36 @@ params, hist = train_pairwise(
 flat = [float(x) for x in np.ravel(np.asarray(params["w"]))] + [
     float(np.asarray(params["b"]))
 ]
+
+# --- triplet trainer across the process boundary [VERDICT r4 next #8] -
+# budgeted degree-3 SGD: on-device triplet draws per worker per step,
+# pmean'd embedding grads and the repartition regather all cross dcn.
+from tuplewise_tpu.models.triplet_sgd import (
+    TripletTrainConfig, init_embed, train_triplet,
+)
+
+tt_cfg = TripletTrainConfig(lr=0.05, steps=8, n_workers=4,
+                            repartition_every=4,
+                            triplets_per_worker=32, embed_dim=2, seed=5)
+tp, th = train_triplet(init_embed(4, 2, seed=5), Xp, Xn, tt_cfg,
+                       mesh=mesh)
+tflat = [float(x) for x in np.ravel(np.asarray(tp["W"]))]
+
+# --- designed incomplete across the process boundary ------------------
+# the device-drawn distinct tuple set (ops.device_design) shards
+# [N, per] over the (dcn, w) mesh; each worker's row regather crosses
+# the process boundary.
+from tuplewise_tpu.estimators.estimator import Estimator
+
+est_d = Estimator("auc", backend="mesh", mesh=mesh, tile_a=32, tile_b=32)
+des = est_d.incomplete(Xp[:, 0], Xn[:, 0], n_pairs=64, seed=2,
+                       design="swor")
+
 print("RESULT", json.dumps({
     "pid": pid, "value": float(val), "mc": mc, "params": flat,
     "last_loss": float(hist["loss"][-1]),
+    "tparams": tflat, "t_last_loss": float(th["loss"][-1]),
+    "designed": float(des),
 }), flush=True)
 """
 
@@ -198,6 +225,35 @@ def test_two_process_ring_matches_oracle(tmp_path):
         np.ravel(np.asarray(params["b"])),
     ])
     np.testing.assert_allclose(recs[0]["params"], want_flat, atol=1e-5)
+
+    # triplet trainer + designed incomplete across the process boundary
+    # [VERDICT r4 next #8]: same (2, 2) local mesh = same fold chains,
+    # so the cross-process run must reproduce the oracle exactly (f32)
+    np.testing.assert_allclose(
+        recs[0]["tparams"], recs[1]["tparams"], atol=1e-6
+    )
+    assert recs[0]["designed"] == pytest.approx(
+        recs[1]["designed"], abs=1e-7
+    )
+    from tuplewise_tpu.estimators.estimator import Estimator
+    from tuplewise_tpu.models.triplet_sgd import (
+        TripletTrainConfig, init_embed, train_triplet,
+    )
+
+    tt_cfg = TripletTrainConfig(lr=0.05, steps=8, n_workers=4,
+                                repartition_every=4,
+                                triplets_per_worker=32, embed_dim=2,
+                                seed=5)
+    tp, _ = train_triplet(init_embed(4, 2, seed=5), Xp, Xn, tt_cfg,
+                          mesh=mesh)
+    np.testing.assert_allclose(
+        recs[0]["tparams"], np.ravel(np.asarray(tp["W"])), atol=1e-5
+    )
+    est_d = Estimator("auc", backend="mesh", mesh=mesh,
+                      tile_a=32, tile_b=32)
+    want_des = est_d.incomplete(Xp[:, 0], Xn[:, 0], n_pairs=64, seed=2,
+                                design="swor")
+    assert recs[0]["designed"] == pytest.approx(want_des, abs=1e-6)
 
 
 class TestFlagGating:

@@ -150,58 +150,130 @@ class TestEstimatorDesigns:
         assert np.var(swor) < 0.6 * np.var(swr)
 
     @pytest.mark.parametrize("design", ["swor", "bernoulli"])
-    def test_mesh_matches_numpy_indices(self, scores, design):
-        """The mesh path draws the SAME global tuple set as the numpy
-        oracle at the same seed (shared host sampler), so the estimate
-        must match to f32 rounding — exact parity, not just
-        unbiasedness."""
+    def test_mesh_design_distribution_matches_oracle(self, scores, design):
+        """jax/mesh draw their designs ON DEVICE (ops.device_design)
+        while numpy keeps the host oracle [VERDICT r4 next #6]: same
+        DISTRIBUTION, not the same tuple set — Monte-Carlo means over
+        seeds must agree within joint SE, and each is unbiased for the
+        complete U."""
         import jax
 
         if jax.device_count() < 8:
             pytest.skip("needs 8 virtual devices")
         s1, s2 = scores
+        u_n = Estimator("auc", backend="numpy").complete(s1, s2)
         est = Estimator("auc", backend="mesh", n_workers=8)
         ref = Estimator("auc", backend="numpy")
-        for seed in (0, 3):
-            got = est.incomplete(s1, s2, n_pairs=4000, seed=seed,
-                                 design=design)
-            want = ref.incomplete(s1, s2, n_pairs=4000, seed=seed,
-                                  design=design)
-            assert abs(got - want) < 1e-6, (design, seed)
+        M = 30
+        got = np.asarray([
+            est.incomplete(s1, s2, n_pairs=4000, seed=m, design=design)
+            for m in range(M)
+        ])
+        want = np.asarray([
+            ref.incomplete(s1, s2, n_pairs=4000, seed=m, design=design)
+            for m in range(M)
+        ])
+        se = np.sqrt((got.var(ddof=1) + want.var(ddof=1)) / M) + 1e-7
+        assert abs(got.mean() - want.mean()) < 5 * se, design
+        assert abs(got.mean() - u_n) < 5 * got.std(ddof=1) / np.sqrt(M) + 1e-6
 
     def test_mesh_one_sample_swor(self):
+        """One-sample (off-diagonal encoded) device designs on the mesh
+        stay unbiased for the complete scatter statistic."""
         import jax
 
         if jax.device_count() < 8:
             pytest.skip("needs 8 virtual devices")
         rng = np.random.default_rng(7)
         A = rng.standard_normal((120, 3))
-        got = Estimator("scatter", backend="mesh", n_workers=8).incomplete(
-            A, n_pairs=3000, seed=5, design="swor")
-        want = Estimator("scatter", backend="numpy").incomplete(
-            A, n_pairs=3000, seed=5, design="swor")
-        assert abs(got - want) / max(abs(want), 1) < 1e-5
+        u_n = Estimator("scatter", backend="numpy").complete(A)
+        est = Estimator("scatter", backend="mesh", n_workers=8)
+        vals = np.asarray([
+            est.incomplete(A, n_pairs=3000, seed=m, design="swor")
+            for m in range(20)
+        ])
+        se = vals.std(ddof=1) / np.sqrt(len(vals)) + 1e-7
+        assert abs(vals.mean() - u_n) < 5 * se
 
     @pytest.mark.parametrize("design", ["swor", "bernoulli"])
-    def test_triplet_designs_all_backends_match(self, design):
+    def test_triplet_designs_all_backends_agree(self, design):
         """The three-design matrix is complete for degree 3 [VERDICT r2
-        next #4]: numpy / jax / mesh share the host sampler, so the
-        same seed yields the same tuple set and matching estimates."""
+        next #4]: numpy draws on host, jax/mesh on device
+        [VERDICT r4 next #6] — the same DESIGN, so Monte-Carlo means
+        over seeds agree within joint SE."""
         import jax
 
         rng = np.random.default_rng(9)
         X = rng.standard_normal((48, 3))
         Y = rng.standard_normal((40, 3))
-        want = Estimator("triplet_indicator", backend="numpy").incomplete(
-            X, Y, n_pairs=900, seed=4, design=design)
-        got_jax = Estimator("triplet_indicator", backend="jax").incomplete(
-            X, Y, n_pairs=900, seed=4, design=design)
-        assert abs(got_jax - want) < 1e-6, design
+        M = 25
+        npy = Estimator("triplet_indicator", backend="numpy")
+        jx = Estimator("triplet_indicator", backend="jax")
+        want = np.asarray([
+            npy.incomplete(X, Y, n_pairs=900, seed=m, design=design)
+            for m in range(M)
+        ])
+        got = np.asarray([
+            jx.incomplete(X, Y, n_pairs=900, seed=m, design=design)
+            for m in range(M)
+        ])
+        se = np.sqrt((got.var(ddof=1) + want.var(ddof=1)) / M) + 1e-7
+        assert abs(got.mean() - want.mean()) < 5 * se, design
         if jax.device_count() >= 8:
-            got_mesh = Estimator(
+            mesh = Estimator(
                 "triplet_indicator", backend="mesh", n_workers=8,
-            ).incomplete(X, Y, n_pairs=900, seed=4, design=design)
-            assert abs(got_mesh - want) < 1e-6, design
+            )
+            got_m = np.asarray([
+                mesh.incomplete(X, Y, n_pairs=900, seed=m, design=design)
+                for m in range(M)
+            ])
+            se_m = np.sqrt((got_m.var(ddof=1) + want.var(ddof=1)) / M) + 1e-7
+            assert abs(got_m.mean() - want.mean()) < 5 * se_m, design
+
+    @pytest.mark.parametrize("design", ["swor", "bernoulli"])
+    def test_device_host_inclusion_distribution_parity(self, design):
+        """Sampler-level design-distribution parity [VERDICT r4 next
+        #6]: on a 20x20 grid at B = G/4, the per-cell inclusion counts
+        of the DEVICE sampler (ops.device_design) and the HOST oracle
+        (parallel.partition) are both Binomial(M, B/G) — every cell
+        equally likely under either implementation."""
+        import jax
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops.device_design import (
+            draw_pair_design_device,
+        )
+
+        n1 = n2 = 20
+        B, M = 100, 400
+        p_cell = B / (n1 * n2)
+
+        f = jax.jit(jax.vmap(
+            lambda k: draw_pair_design_device(k, n1, n2, B, design)
+        ))
+        i_d, j_d, w_d = (np.asarray(x) for x in f(
+            jax.vmap(jax.random.PRNGKey)(jnp.arange(M))
+        ))
+        counts_dev = np.zeros((n1, n2))
+        counts_host = np.zeros((n1, n2))
+        for t in range(M):
+            sel = w_d[t] > 0
+            counts_dev[i_d[t][sel], j_d[t][sel]] += 1
+            ih, jh = draw_pair_design(
+                np.random.default_rng(t), n1, n2, B, design
+            )
+            counts_host[ih, jh] += 1
+        sd = np.sqrt(M * p_cell * (1 - p_cell))
+        for name, counts in (("device", counts_dev),
+                             ("host", counts_host)):
+            # each sampler realizes ~B inclusions per draw on average;
+            # bernoulli's size varies, so compare against the EMPIRICAL
+            # per-cell mean (uniformity is the property under test)
+            z = (counts - counts.mean()) / sd
+            assert np.max(np.abs(z)) < 5.0, (name, np.max(np.abs(z)))
+            # and the average inclusion rate matches B/G
+            tot_sd = np.sqrt(M * B * (1 - p_cell))
+            assert abs(counts.sum() - M * B) < 5 * tot_sd, name
 
     def test_triplet_swor_unbiased(self):
         """SWOR triplet sampling stays unbiased for the complete

@@ -123,3 +123,82 @@ class TestTripletSGD:
                 init_embed(4, 2), np.zeros((8, 4)), np.zeros((8, 4)),
                 TripletTrainConfig(kernel="hinge"),
             )
+
+
+class TestEmbedderPlugin:
+    """Scorer-discipline embedders [VERDICT r4 next #9]: any frozen
+    dataclass with apply(params, X, xp) trains through the same
+    budgeted path; a bare {"W"} dict still means the linear map."""
+
+    @staticmethod
+    def _radial(seed, n=400):
+        rng = np.random.default_rng(seed)
+
+        def shell(m, r_lo, r_hi):
+            v = rng.standard_normal((m, 8))
+            v /= np.linalg.norm(v, axis=1, keepdims=True)
+            r = rng.uniform(r_lo, r_hi, size=(m, 1))
+            return (v * r).astype(np.float32)
+
+        X, Y = shell(n, 0.5, 1.0), shell(2 * n, 1.8, 2.6)
+        return X[:300], Y[:600], X[300:], Y[600:]
+
+    def test_mlp_embedder_beats_linear_on_radial(self):
+        """Radial classes (Bayes ceiling 1.0) are linearly
+        inseparable: the linear embedding plateaus, the MLP through
+        the SAME budgeted path climbs past it."""
+        from tuplewise_tpu.models.scorers import LinearEmbed, MLPEmbed
+
+        Xc_tr, Xo_tr, Xc_te, Xo_te = self._radial(0)
+        cfg = TripletTrainConfig(
+            lr=0.3, steps=400, n_workers=4, repartition_every=10,
+            triplets_per_worker=1024, seed=0, embed_dim=2,
+        )
+        finals = {}
+        for name, emb in (("linear", LinearEmbed(dim=8, embed_dim=2)),
+                          ("mlp", MLPEmbed(dim=8, hidden=32,
+                                           embed_dim=2))):
+            p1, _ = train_triplet(emb.init(0), Xc_tr, Xo_tr, cfg,
+                                  embedder=emb)
+            finals[name] = evaluate_triplet_accuracy(
+                p1, Xc_te, Xo_te, embedder=emb)
+        assert finals["mlp"] > finals["linear"] + 0.05, finals
+
+    def test_mlp_checkpoint_resume_and_mismatch(self, tmp_path):
+        """MLP runs checkpoint/resume exactly; resuming with a
+        different embedder fails as a config mismatch."""
+        from tuplewise_tpu.models.scorers import MLPEmbed
+
+        Xc_tr, Xo_tr, _, _ = self._radial(1)
+        emb = MLPEmbed(dim=8, hidden=16, embed_dim=2)
+        cfg = TripletTrainConfig(
+            lr=0.1, steps=12, n_workers=4, repartition_every=4,
+            triplets_per_worker=128, seed=2, embed_dim=2,
+        )
+        p_straight, h_straight = train_triplet(
+            emb.init(1), Xc_tr, Xo_tr, cfg, embedder=emb)
+        ckpt = str(tmp_path / "mlp.npz")
+        cfg6 = type(cfg)(**{**cfg.__dict__, "steps": 6})
+        train_triplet(emb.init(1), Xc_tr, Xo_tr, cfg6, embedder=emb,
+                      checkpoint_path=ckpt)
+        p_res, h_res = train_triplet(
+            emb.init(1), Xc_tr, Xo_tr, cfg, embedder=emb,
+            checkpoint_path=ckpt)
+        for k in p_straight:
+            np.testing.assert_array_equal(p_straight[k], p_res[k])
+        np.testing.assert_allclose(h_straight["loss"], h_res["loss"],
+                                   atol=1e-7)
+        other = MLPEmbed(dim=8, hidden=32, embed_dim=2)
+        with pytest.raises(ValueError):
+            train_triplet(other.init(1), Xc_tr, Xo_tr, cfg,
+                          embedder=other, checkpoint_path=ckpt)
+
+    def test_bare_params_require_linear_shape(self):
+        from tuplewise_tpu.models.scorers import MLPEmbed
+
+        p_mlp = MLPEmbed(dim=8, hidden=16, embed_dim=2).init(0)
+        with pytest.raises(ValueError, match="embedder"):
+            train_triplet(
+                p_mlp, np.zeros((16, 8), np.float32),
+                np.zeros((16, 8), np.float32), TripletTrainConfig(),
+            )

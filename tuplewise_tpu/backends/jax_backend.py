@@ -231,20 +231,44 @@ class JaxBackend:
             incomplete_fn, static_argnames=("n_pairs",)
         )
 
-        def gather_mean_fn(A, B, i, j):
-            return jnp.mean(
-                k.pair_elementwise(A[i], B[j], jnp), dtype=A.dtype
+        def designed_fn(A, B, key, n_pairs, design):
+            """Distinct-design incomplete mean, drawn AND evaluated on
+            device in one jitted program (ops.device_design — the single
+            overdraw → sort-dedup → subselect sampler shared with the
+            learning side and the mesh paths) [VERDICT r4 next #6].
+            Fixed shapes: bernoulli's Binomial size lives in the weight
+            mask, so one compile serves every seed."""
+            from tuplewise_tpu.ops.device_design import (
+                draw_pair_design_device, draw_triplet_design_device,
             )
 
-        def gather_triplet_mean_fn(A, B, i, j, kk):
-            return jnp.mean(
-                k.triplet_values(A[i], A[j], B[kk], jnp), dtype=A.dtype
-            )
+            # floor_one: estimation semantics — bernoulli's realized
+            # size clamps at >= 1 so the mean stays defined (the host
+            # oracle's documented behavior)
+            if k.kind == "triplet":
+                i, j, kk, w = draw_triplet_design_device(
+                    key, A.shape[0], B.shape[0], n_pairs, design,
+                    floor_one=True,
+                )
+                vals = k.triplet_values(A[i], A[j], B[kk], jnp)
+            elif k.two_sample:
+                i, j, w = draw_pair_design_device(
+                    key, A.shape[0], B.shape[0], n_pairs, design,
+                    floor_one=True,
+                )
+                vals = k.pair_elementwise(A[i], B[j], jnp)
+            else:
+                i, j, w = draw_pair_design_device(
+                    key, A.shape[0], A.shape[0] - 1, n_pairs, design,
+                    one_sample=True, floor_one=True,
+                )
+                vals = k.pair_elementwise(A[i], A[j], jnp)
+            return (jnp.sum(vals * w, dtype=jnp.float32)
+                    / jnp.sum(w, dtype=jnp.float32))
 
-        # host-designed samples (swor/bernoulli): indices come from the
-        # shared NumPy sampler, only the kernel evaluation is on device
-        self._gather_mean = jax.jit(gather_mean_fn)
-        self._gather_triplet_mean = jax.jit(gather_triplet_mean_fn)
+        self._designed = jax.jit(
+            designed_fn, static_argnames=("n_pairs", "design")
+        )
 
     # ------------------------------------------------------------------ #
     def _dev(self, A, B):
@@ -284,39 +308,22 @@ class JaxBackend:
 
     def incomplete(self, A, B=None, *, n_pairs, seed=0, design="swr"):
         """B sampled tuples; design in {"swr", "swor", "bernoulli"}
-        [SURVEY §1.1 incomplete]. "swr" samples on device inside the
-        jitted program; the distinct-tuple designs draw indices with the
-        shared host sampler (parallel.partition.draw_pair_design) and
-        evaluate the kernel on device — index generation is O(B) host
-        work, the O(B) kernel math stays compiled. (bernoulli's realized
-        sample size varies, so each new size compiles once.)"""
+        [SURVEY §1.1 incomplete]. Every design runs on device inside
+        ONE jitted program: "swr" via the legacy uniform draws, the
+        distinct designs via ops.device_design [VERDICT r4 next #6] —
+        fixed shapes, one compile per (n_pairs, design), no host
+        sampling sync. The host sampler (parallel.partition) remains
+        the semantic oracle; distribution parity is pinned in
+        tests/test_sampling_designs.py. Device designs bound the budget
+        at 0.8 * grid (near-complete budgets belong to the complete
+        estimator or the numpy backend's host sampler)."""
         A, B = self._dev(A, B)
         if design != "swr":
-            if self.kernel.kind == "triplet":
-                from tuplewise_tpu.parallel.partition import (
-                    draw_triplet_design,
-                )
-
-                i, j, kk = draw_triplet_design(
-                    np.random.default_rng(seed), A.shape[0], B.shape[0],
-                    n_pairs, design,
-                )
-                return float(self._gather_triplet_mean(
-                    A, B, jnp.asarray(i), jnp.asarray(j),
-                    jnp.asarray(kk)))
-            from tuplewise_tpu.parallel.partition import draw_pair_design
-
-            one_sample = not self.kernel.two_sample
-            Bv = A if B is None else B
-            n1 = A.shape[0]
-            n2 = n1 - 1 if one_sample else Bv.shape[0]
-            i, j = draw_pair_design(
-                np.random.default_rng(seed), n1, n2, n_pairs, design,
-                one_sample=one_sample,
-            )
-            return float(self._gather_mean(
-                A, A if one_sample else Bv,
-                jnp.asarray(i), jnp.asarray(j)))
+            return float(self._designed(
+                A, B if B is not None else A,
+                fold(root_key(seed), "design"),
+                n_pairs=n_pairs, design=design,
+            ))
         key = fold(root_key(seed), "incomplete")
         return float(self._incomplete(
             A, B if B is not None else A, key, n_pairs=n_pairs))

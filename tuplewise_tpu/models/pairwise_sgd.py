@@ -137,7 +137,9 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
                 kk, m1, m2, cfg.pairs_per_worker, cfg.pair_design
             )
             vals = kernel.diff(s1[i] - s2[j], jnp)
-            return jnp.sum(vals * w) / jnp.sum(w)
+            # max(., 1): an exact small-G bernoulli draw can realize an
+            # EMPTY design — a zero-weight step, not NaN
+            return jnp.sum(vals * w) / jnp.maximum(jnp.sum(w), 1.0)
 
         if cfg.pairs_per_worker is None and cfg.loss_every != 1:
             # both branches traced once; each step executes ONE grid

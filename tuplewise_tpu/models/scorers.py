@@ -58,3 +58,51 @@ class MLPScorer:
 def init_scorer(name: str, dim: int, seed: int = 0, **kw):
     scorer = {"linear": LinearScorer, "mlp": MLPScorer}[name](dim, **kw)
     return scorer, scorer.init(seed)
+
+
+# --------------------------------------------------------------------- #
+# Embedding models e_theta: R^d -> R^k for the triplet learner          #
+# [SURVEY §1.3 learner generality; VERDICT r4 next #9]                  #
+# --------------------------------------------------------------------- #
+
+@dataclasses.dataclass(frozen=True)
+class LinearEmbed:
+    """e(x) = x @ W — the paper's linear metric (Mahalanobis factor)."""
+
+    dim: int
+    embed_dim: int
+
+    def init(self, seed: int = 0) -> Params:
+        rng = np.random.default_rng(seed)
+        return {
+            "W": rng.standard_normal((self.dim, self.embed_dim))
+            / np.sqrt(self.dim),
+        }
+
+    def apply(self, params: Params, X, xp) -> Any:
+        return X @ params["W"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPEmbed:
+    """Two-layer tanh MLP embedding: e(x) = tanh(x @ W1 + b1) @ W2 —
+    a NONLINEAR metric through the same budgeted triplet path; closes
+    the Bayes-ceiling gap on tasks a linear projection cannot separate
+    (e.g. radial class structure, RESULTS §6.5b)."""
+
+    dim: int
+    hidden: int = 32
+    embed_dim: int = 2
+
+    def init(self, seed: int = 0) -> Params:
+        rng = np.random.default_rng(seed)
+        return {
+            "W1": rng.standard_normal((self.dim, self.hidden))
+            / np.sqrt(self.dim),
+            "b1": np.zeros(self.hidden),
+            "W2": rng.standard_normal((self.hidden, self.embed_dim))
+            / np.sqrt(self.hidden),
+        }
+
+    def apply(self, params: Params, X, xp) -> Any:
+        return xp.tanh(X @ params["W1"] + params["b1"]) @ params["W2"]

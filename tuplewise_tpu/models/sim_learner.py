@@ -125,7 +125,9 @@ def _compiled_sim_trainer(scorer, cfg, n1, n2):
             kk, m1, m2, cfg.pairs_per_worker, cfg.pair_design
         )
         vals = kernel.diff(s1[i] - s2[j], jnp)
-        return jnp.sum(vals * w) / jnp.sum(w)
+        # max(., 1): an exact small-G bernoulli draw can realize an
+        # EMPTY design — a zero-weight step, not NaN
+        return jnp.sum(vals * w) / jnp.maximum(jnp.sum(w), 1.0)
 
     def draw_both(kr):
         k1, k2 = jax.random.split(kr)

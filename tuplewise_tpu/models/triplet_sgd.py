@@ -67,15 +67,28 @@ def init_embed(dim: int, embed_dim: int, seed: int = 0) -> dict:
     return {"W": rng.standard_normal((dim, embed_dim)) / np.sqrt(dim)}
 
 
-def _embed(params, X):
-    return X @ params["W"]
+def _default_embedder(params):
+    """Back-compat: a bare {"W": [d, k]} params dict means the linear
+    embedding the r4 API trained (models.scorers.LinearEmbed)."""
+    from tuplewise_tpu.models.scorers import LinearEmbed
+
+    if "W" not in params:
+        raise ValueError(
+            "params carry no linear 'W' — pass the matching embedder= "
+            "(models.scorers.MLPEmbed etc.) explicitly"
+        )
+    d, k = np.shape(params["W"])
+    return LinearEmbed(dim=int(d), embed_dim=int(k))
 
 
 @functools.lru_cache(maxsize=8)
-def _compiled_triplet_trainer(cfg, mesh, n1, n2):
+def _compiled_triplet_trainer(embedder, cfg, mesh, n1, n2):
     """Compiled chunk program (same caching/chunking contract as
     pairwise_sgd._compiled_trainer: keys fold from absolute step
-    indices, so chunked runs reproduce unchunked bit-for-bit)."""
+    indices, so chunked runs reproduce unchunked bit-for-bit).
+    ``embedder`` is any frozen-dataclass plugin with
+    ``apply(params, X, xp)`` — the scorer discipline of the pairwise
+    learner applied to embeddings [VERDICT r4 next #9]."""
     from tuplewise_tpu.parallel.device_partition import draw_blocks as _draw
 
     kernel = get_kernel(cfg.kernel)
@@ -99,13 +112,15 @@ def _compiled_triplet_trainer(cfg, mesh, n1, n2):
                 draw_triplet_design_device,
             )
 
-            ea = _embed(p, a[0])
-            eb = _embed(p, b[0])
+            ea = embedder.apply(p, a[0], jnp)
+            eb = embedder.apply(p, b[0], jnp)
             i, j, n, w = draw_triplet_design_device(
                 kk, m1, m2, B, cfg.triplet_design
             )
             vals = kernel.triplet_values(ea[i], ea[j], eb[n], jnp)
-            return jnp.sum(vals * w) / jnp.sum(w)
+            # max(., 1): an exact small-G bernoulli draw can realize an
+            # EMPTY design — a zero-weight step, not NaN
+            return jnp.sum(vals * w) / jnp.maximum(jnp.sum(w), 1.0)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)
@@ -174,6 +189,7 @@ def train_triplet(
     eval_data=None,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: Optional[int] = None,
+    embedder=None,
 ):
     """Distributed triplet SGD: anchors/positives from X_class (the
     target class), negatives from X_other. Returns (params, history);
@@ -181,6 +197,11 @@ def train_triplet(
     also carries the held-out triplet-accuracy curve (training runs in
     scan chunks between evaluations; keys fold from absolute step
     indices, so the chunked trajectory IS the unchunked one).
+
+    ``embedder``: any frozen-dataclass plugin with
+    ``apply(params, X, xp)`` (models.scorers.LinearEmbed / MLPEmbed)
+    [VERDICT r4 next #9]; None infers the linear embedding from a bare
+    {"W": [d, k]} params dict, so the r4 call sites run unchanged.
 
     Checkpoint/resume [SURVEY §5.5, same contract as train_pairwise]:
     with ``checkpoint_path``, params + loss history + the accuracy
@@ -217,16 +238,28 @@ def train_triplet(
         jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params),
         replicated,
     )
+    if embedder is None:
+        embedder = _default_embedder(params)
     run_chunk = _compiled_triplet_trainer(
-        dataclasses.replace(cfg, steps=0), mesh, n1, n2
+        embedder, dataclasses.replace(cfg, steps=0), mesh, n1, n2
     )
 
     from tuplewise_tpu.utils.checkpoint import (
         resume_progress, save_checkpoint,
     )
 
+    # embedder identity is part of the checkpoint contract: resuming a
+    # linear run with an MLP embedder (or vice versa) must fail loudly
+    # as a config mismatch, not as a shape error deep in device_put.
+    # The inferred-linear default keeps the r4 config schema (no
+    # 'embedder' key), so pre-r5 linear checkpoints still resume.
+    from tuplewise_tpu.models.scorers import LinearEmbed
+
+    ck_config = dataclasses.asdict(cfg)
+    if not isinstance(embedder, LinearEmbed):
+        ck_config["embedder"] = repr(embedder)
     start, ck = resume_progress(
-        checkpoint_path, dataclasses.asdict(cfg),
+        checkpoint_path, ck_config,
         progress_key="steps", requested=cfg.steps,
     )
     loss_parts, curve_steps, curve_acc = [], [], []
@@ -264,7 +297,7 @@ def train_triplet(
                 "curve_steps": np.asarray(curve_steps),
                 "curve_acc": np.asarray(curve_acc),
             },
-            config=dataclasses.asdict(cfg),
+            config=ck_config,
         )
 
     t0 = start
@@ -279,7 +312,8 @@ def train_triplet(
         ):
             curve_steps.append(t1)
             curve_acc.append(
-                evaluate_triplet_accuracy(params, *eval_data)
+                evaluate_triplet_accuracy(params, *eval_data,
+                                          embedder=embedder)
             )
         if checkpoint_path and (
             ckpt_every is None or t1 % ckpt_every == 0
@@ -310,16 +344,19 @@ def _eval_estimator():
 
 def evaluate_triplet_accuracy(
     params, X_class, X_other, *, n_triplets: Optional[int] = None,
-    seed: int = 0,
+    seed: int = 0, embedder=None,
 ) -> float:
     """Config 4's indicator statistic on the EMBEDDED data — the
     fraction of (i, j in class; k outside) relative-similarity
     constraints the learned metric satisfies. Complete by default
     (the Pallas distance factorization makes it cheap); pass
-    n_triplets for the incomplete estimate at large n."""
+    n_triplets for the incomplete estimate at large n. ``embedder``
+    defaults to the linear map a bare {"W"} params dict implies."""
+    if embedder is None:
+        embedder = _default_embedder(params)
     p = jax.tree.map(np.asarray, params)
-    Ec = np.asarray(_embed(p, np.asarray(X_class)))
-    Eo = np.asarray(_embed(p, np.asarray(X_other)))
+    Ec = np.asarray(embedder.apply(p, np.asarray(X_class), np))
+    Eo = np.asarray(embedder.apply(p, np.asarray(X_other), np))
     est = _eval_estimator()
     if n_triplets is None:
         return est.complete(Ec, Eo)

@@ -21,14 +21,26 @@ Construction (all shapes static):
              sampler up to the astronomically rare D < B shortfall,
              which the weight mask prices correctly (renormalized mean,
              never a wrong estimate).
-  bernoulli  realized size K_real ~ Binomial(G, B/G) (normal
-             approximation — exact to float tolerance for the G >= 10^4
-             grids the budget regime uses), then the swor machinery
-             keeps the first min(K_real, D, L) selected tuples.
+  bernoulli  realized size K_real ~ Binomial(G, B/G) — drawn EXACTLY
+             for G <= _EXACT_BINOMIAL_MAX_G by reducing G device
+             Bernoulli bits (a true Binomial draw, 0 included: a small
+             grid at a small rate realizes an EMPTY design ~(1-p)^G of
+             the time, and consumers price that as a zero-weight step,
+             see below) [VERDICT r4 next #2]; the normal approximation
+             serves only grids ABOVE that threshold, safely inside its
+             documented G >= 10^4 validity bound. Either way the swor
+             machinery keeps the first min(K_real, D, L) selected
+             tuples.
 
-Returns (i, j, w): [L] index arrays plus a {0,1} weight mask; consumers
-compute sum(vals * w) / sum(w). L = B for swr/swor and B + 8 sqrt(B)
-for bernoulli, so every design compiles once per (B, grid) shape.
+Returns (i, j, w): [L] index arrays plus a {0,1} weight mask.
+LEARNING consumers compute sum(vals * w) / max(sum(w), 1) — the max
+prices an empty bernoulli realization as a zero-loss, zero-gradient
+step instead of NaN. ESTIMATION consumers (jax/mesh backends, both
+harness runners) pass ``floor_one=True`` instead: bernoulli's realized
+size clamps at >= 1, the host oracle's documented semantics ("floored
+at 1 so the estimator stays defined") — a mean over an empty tuple set
+has no value to price. L = B for swr/swor and B + 8 sqrt(B) for
+bernoulli, so every design compiles once per (B, grid) shape.
 
 Why sort-based dedup and not linearized `jnp.unique`: the per-worker
 grid m1*m2 reaches 4e11 at production block sizes — linearizing
@@ -44,6 +56,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# bernoulli realized-size threshold: at or below this grid size the
+# Binomial draw is EXACT (G reduced Bernoulli bits, O(G) per draw —
+# negligible against the O(K log K) dedup sort); above it the normal
+# approximation runs, always inside its documented G >= 10^4 bound
+# [VERDICT r4 next #2]
+_EXACT_BINOMIAL_MAX_G = 65536
+
 
 def _overdraw(grid: int, budget: int) -> int:
     """Static with-replacement draw count K such that the expected
@@ -57,7 +76,8 @@ def _overdraw(grid: int, budget: int) -> int:
     return max(budget, int(math.ceil(k)))
 
 
-def _distinct_design(key, dims, budget: int, design: str, what: str):
+def _distinct_design(key, dims, budget: int, design: str, what: str,
+                     floor_one: bool = False):
     """(cols, w): ``budget``-sized distinct-tuple draw from the product
     grid prod(dims), in ENCODED coordinates (off-diagonal encodings are
     the callers' business). ONE implementation of the
@@ -108,11 +128,28 @@ def _distinct_design(key, dims, budget: int, design: str, what: str):
         take = jnp.asarray(L, jnp.float32)
     else:
         p = budget / grid
-        sd = math.sqrt(grid * p * (1.0 - p))
-        draw = jnp.round(
-            budget + sd * jax.random.normal(kb, (), jnp.float32)
-        )
-        take = jnp.clip(draw, 1.0, float(L))
+        if grid <= _EXACT_BINOMIAL_MAX_G:
+            # EXACT Binomial(G, p): reduce G device Bernoulli bits
+            # [VERDICT r4 next #2]. Zero is a legitimate realization
+            # ((1-p)^G ~ 1% at G=16, p=1/4) — consumers divide by
+            # max(sum(w), 1), so an empty design is a zero-weight
+            # step, never NaN.
+            bits = jax.random.uniform(kb, (grid,)) < p
+            draw = jnp.sum(bits).astype(jnp.float32)
+        else:
+            # normal approximation — only ever reached at
+            # G > _EXACT_BINOMIAL_MAX_G, inside the documented
+            # G >= 10^4 validity bound (TV error O(1/sqrt(G p (1-p)))
+            draw = jnp.round(
+                budget
+                + math.sqrt(grid * p * (1.0 - p))
+                * jax.random.normal(kb, (), jnp.float32)
+            )
+        # floor_one mirrors the host oracle's documented estimation
+        # semantics ("floored at 1 so the estimator stays defined",
+        # parallel.partition.draw_pair_design); the learning consumers
+        # keep the TRUE draw (0 included — a zero-weight step)
+        take = jnp.clip(draw, 1.0 if floor_one else 0.0, float(L))
     w = (valid & (jnp.arange(L) < take)).astype(jnp.float32)
     return outs, w
 
@@ -133,6 +170,7 @@ def draw_pair_design_device(
     design: str = "swr",
     *,
     one_sample: bool = False,
+    floor_one: bool = False,
 ):
     """(i, j, w) sampling the n1 x n2 grid under ``design`` — the
     device-side mirror of parallel.partition.draw_pair_design.
@@ -141,6 +179,12 @@ def draw_pair_design_device(
     n2 = n1 - 1 columns, exactly like the host sampler: dedup happens
     in encoded (pre-shift) coordinates, the returned j is shifted past
     i for direct indexing.
+
+    floor_one: clamp bernoulli's realized size at >= 1 — the host
+    oracle's ESTIMATION semantics (a mean over an empty tuple set is
+    undefined, so the estimator-side callers keep it defined); the
+    learning consumers leave it False and price an empty draw as a
+    zero-weight step.
     """
     from tuplewise_tpu.ops.pair_tiles import sample_pair_indices
 
@@ -150,7 +194,7 @@ def draw_pair_design_device(
         return i, j, jnp.ones(n_pairs, jnp.float32)
     _check_design(design)
     (i_f, j_f), w = _distinct_design(
-        key, (n1, n2), n_pairs, design, "tuples"
+        key, (n1, n2), n_pairs, design, "tuples", floor_one=floor_one
     )
     if one_sample:
         j_f = jnp.where(j_f >= i_f, j_f + 1, j_f)
@@ -163,13 +207,16 @@ def draw_triplet_design_device(
     n2: int,
     n_triplets: int,
     design: str = "swr",
+    *,
+    floor_one: bool = False,
 ):
     """(i, j, k, w) sampling the off-diagonal triple grid
     {i != j in [0, n1)} x [0, n2) under ``design`` — the degree-3
     mirror of draw_pair_design_device for the triplet trainer's
     per-step budgets [SURVEY §1.2 item 4 at degree 3]. The positive
     index j is encoded off-diagonal (n1 - 1 columns) during dedup and
-    shifted past i on return, exactly like the host sampler."""
+    shifted past i on return, exactly like the host sampler.
+    ``floor_one``: see draw_pair_design_device."""
     if design == "swr":
         ki, kj, kk = jax.random.split(key, 3)
         i = jax.random.randint(ki, (n_triplets,), 0, n1)
@@ -179,7 +226,22 @@ def draw_triplet_design_device(
         return i, j, k, jnp.ones(n_triplets, jnp.float32)
     _check_design(design)
     (i_f, j_f, k_f), w = _distinct_design(
-        key, (n1, n1 - 1, n2), n_triplets, design, "triples"
+        key, (n1, n1 - 1, n2), n_triplets, design, "triples",
+        floor_one=floor_one,
     )
     j_f = jnp.where(j_f >= i_f, j_f + 1, j_f)
     return i_f, j_f, k_f, w
+
+
+def shard_design_blocks(cols, w, n_shards: int, dtype=None):
+    """Pad a [L] device draw to n_shards * per and shape [N, per]
+    worker blocks + weight mask — the ONE copy of the mesh sharding
+    helper used by backends.mesh_backend and harness.mesh_mc (a
+    padding/weight change must hit both consumers at once)."""
+    L = cols[0].shape[0]
+    per = -(-L // n_shards)
+    pad = n_shards * per - L
+    out = [jnp.pad(c, (0, pad)).reshape(n_shards, per) for c in cols]
+    wp = jnp.pad(w, (0, pad)).reshape(n_shards, per)
+    out.append(wp if dtype is None else wp.astype(dtype))
+    return out
