@@ -710,7 +710,9 @@ def stage_trace(q, platform):
     20-step run with n_r=2 under jax.profiler, digested to text by
     scripts/trace_summary.py (results/trace_train_chip_summary.txt).
     The repartition events appear as conditional/dynamic-slice/gather
-    rows against the step scan's while loop."""
+    rows against the step scan's while loop. r5: the traced config is
+    LOSS-FREE (loss_every > steps) — the production recommendation —
+    so the digest shows the grad-only kernel dominating the step."""
     import subprocess
 
     import jax
@@ -724,7 +726,8 @@ def stage_trace(q, platform):
     scorer = LinearScorer(dim=5)
     p0 = scorer.init(0)
     cfg = TrainConfig(kernel="hinge", lr=0.3, steps=20, n_workers=1,
-                      repartition_every=2, seed=7, tile=2048)
+                      repartition_every=2, seed=7, tile=2048,
+                      loss_every=NEVER)
     train_pairwise(scorer, p0, Xp, Xn, cfg)   # warm SAME chunk length
     trace_dir = _out_path("trace_train_chip")
     import shutil

@@ -251,3 +251,53 @@ class TestRankAucFastPath:
             Estimator("auc", backend="jax", auc_fast=False,
                       tile_a=256, tile_b=256).complete(s1, s2) - ref
         ) < 1e-6
+
+
+class TestTripletPreferredDispatch:
+    """preferred_anchor_chunk / preferred_triplet_tile_k [VERDICT r4
+    next #4]: the HBM-aware chunk and K-dependent lane tile, pinned so
+    a future change cannot silently regress the large-n path into the
+    16 GB wall the r4 layout hit."""
+
+    def test_anchor_chunk_regimes(self):
+        from tuplewise_tpu.ops.pallas_triplets import (
+            preferred_anchor_chunk,
+        )
+
+        # measured-best 256 wherever the distance matrices fit
+        assert preferred_anchor_chunk(4096, 4096) == 256
+        assert preferred_anchor_chunk(16384, 16384) == 256
+        assert preferred_anchor_chunk(65536, 65536) == 256
+        # ~2 GB budget: C * (P + K) * 4 bytes bounded
+        c = preferred_anchor_chunk(10**7, 10**7)
+        assert c * (2 * 10**7) * 4 <= 2 * (1 << 30)
+        assert c >= 8
+
+    def test_tile_k_regimes(self):
+        from tuplewise_tpu.ops.pallas_triplets import (
+            preferred_triplet_tile_k,
+        )
+
+        assert preferred_triplet_tile_k(4096) == 4096
+        assert preferred_triplet_tile_k(16384) == 8192
+        assert preferred_triplet_tile_k(65536) == 8192
+
+    def test_auto_dispatch_matches_explicit(self):
+        """anchor_chunk=0 / tile_k=0 resolve to the preferred values
+        and produce the exact same statistic (interpret mode)."""
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops.kernels import get_kernel
+        from tuplewise_tpu.ops.pallas_triplets import (
+            pallas_triplet_stats,
+        )
+
+        k = get_kernel("triplet_indicator")
+        rng = np.random.default_rng(3)
+        X = jnp.asarray(rng.standard_normal((60, 4)), jnp.float32)
+        Y = jnp.asarray(rng.standard_normal((52, 4)) + 0.3, jnp.float32)
+        s0, c0 = pallas_triplet_stats(k, X, Y, interpret=True)
+        s1, c1 = pallas_triplet_stats(
+            k, X, Y, anchor_chunk=256, tile_k=4096, interpret=True
+        )
+        assert float(s0) == float(s1) and float(c0) == float(c1)

@@ -13,19 +13,24 @@ as the native C++ engine's distance-reuse loop
 (native/pair_sum.cpp::triplet_stats_native), mapped to TPU:
 
 1. anchors stream in chunks; per chunk the two distance matrices
-   D_ap [C, P] and D_an [C, K] come from one |a|^2/|b|^2/a@b.T
-   assembly each (MXU work);
-2. per anchor row, sum_{j,k} g(D_ap[j] - D_an[k]) is EXACTLY the
-   masked pair-sum problem on score vectors (D_ap[i], D_an[i]) with
-   the combine g as a diff kernel — the hand-tiled
-   `pallas_masked_pair_sum` runs it under `jax.vmap` over the chunk,
-   per-anchor j-masks carrying the ids_x != ids_p exclusion.
+   D_pa [P, C] (anchors in LANES — each anchor's positive distances
+   are a natural (8, 128)-tiled column) and D_an [C, K] come from one
+   |a|^2/|b|^2/a@b.T assembly each (MXU work);
+2. the BATCHED pair kernel (`_batched_pair_sum_kernel`, r5) reduces
+   sum_{j,k} g(D_pa[j,c] - D_an[c,k]) for every anchor c of the chunk
+   in ONE grid (C, P/Tp, K/Tk) traversal — the same sublane x lane
+   broadcast and Kahan cells as the pair kernels, with per-anchor
+   j-masks carrying the ids_x != ids_p exclusion. (The r4 design
+   vmapped the masked PAIR kernel per anchor, which reshaped each
+   distance row to a [P, 1] column whose unit lane dim padded 128x in
+   HBM — 2 x 8 GB of HLO temp at C=1024, P=16384; the batched layout
+   removed that wall and lifted n=16384 from 6.2e11 to ~9e11
+   triplets/s.)
 
-No new Pallas kernel: the pair kernel's sublane x lane layout, SMEM
-Kahan cells, and vmap batching are reused as-is. Only the two built-in
-triplet kernels qualify (identity dispatch on triplet_fn, margin read
-off the function default — the cpp_backend discipline); custom triplet
-kernels keep the XLA tile path (ops.pair_tiles.triplet_stats).
+Only the two built-in triplet kernels qualify (identity dispatch on
+triplet_fn, margin read off the function default — the cpp_backend
+discipline); custom triplet kernels keep the XLA tile path
+(ops.pair_tiles.triplet_stats).
 """
 
 from __future__ import annotations
@@ -36,6 +41,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
 
 from tuplewise_tpu.ops.kernels import Kernel
 
@@ -84,6 +90,105 @@ def _sqdist_matrix(a, b):
     return an[:, None] + bn[None, :] - 2.0 * cross
 
 
+def preferred_anchor_chunk(n_pos: int, n_neg: int) -> int:
+    """HBM-aware anchor chunk for the factorized path [VERDICT r4 next
+    #4]: the live per-chunk distance matrices D_pa [P, C] and D_an
+    [C, K] cost C * (P + K) * 4 bytes f32 (natural (8, 128) tiling —
+    the r4 per-anchor vmap layout padded a unit lane dim 128x and
+    OOM'd 16 GB HBM at C=1024, P=16384; the batched kernel removed
+    that). 256 is the measured-best chunk (1.00e12 tr/s at n=16384,
+    tk=8192 — ~4% over C=512); huge grids shrink C further to bound
+    the matrices + remat copies inside ~2 GB."""
+    budget = 2 * (1 << 30)
+    cap = budget // ((n_pos + n_neg) * 4 + 1)
+    c = 256   # measured-best on v5e (1.00e12 tr/s at n=16384, tk=8192)
+    while c > 8 and c > cap:
+        c //= 2
+    return c
+
+
+def preferred_triplet_tile_k(n_neg: int) -> int:
+    """Measured-best negative-lane tile on v5e: 8192 lanes win once K
+    amortizes them (9.8e11 vs 9.3e11 tr/s at K=16384); smaller K keeps
+    4096 (8192 loses ~4% at K=4096 to padding/pipeline drain)."""
+    return 8192 if n_neg >= 16384 else 4096
+
+
+def _batched_pair_sum_kernel(a_ref, b_ref, ma_ref, mb_ref, o_ref, *, g):
+    """One anchor chunk's sum_{j,k} g(D_pa[j,c] - D_an[c,k]) * mj * mk
+    for every anchor c, in ONE grid (P/Tp, C, K/Tk) traversal:
+
+    * a_ref/ma_ref [Tp, C]: a full row block of the [P, C] distance /
+      mask matrices (anchors in LANES — natural (8, 128) tiling; the
+      r4 per-anchor vmap reshaped rows to [P, 1] columns whose unit
+      lane dim padded 128x in HBM). Anchor c's column is extracted
+      in-kernel by a one-hot lane reduction (Mosaic cannot prove a
+      width-1 dynamic lane slice 128-aligned) — Tp*C VPU work per
+      step, ~C/Tk of the main reduction; the block index ignores c,
+      so the fetch is elided across the (c, j) sweep;
+    * b_ref [1, Tk]: anchor c's negative-distance block from the
+      FLATTENED [1, C*K] layout (block c*gk + j) — a [C, K] block of
+      (1, Tk) would be an illegal Mosaic shape (second-to-last dim 1
+      neither divisible by 8 nor the full C);
+    * o_ref [2, C]: lane-per-anchor (sum, compensation) accumulator,
+      resident for the WHOLE grid (constant index map). The Kahan add
+      touches only lane c by masking: other lanes add an exact 0 to
+      the sum and keep their compensation untouched.
+    """
+    c = pl.program_id(1)
+    first = (pl.program_id(0) == 0) & (c == 0) & (pl.program_id(2) == 0)
+
+    @pl.when(first)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    onehot = (lax.broadcasted_iota(
+        jnp.int32, (1, a_ref.shape[1]), 1) == c).astype(jnp.float32)
+    a_col = jnp.sum(a_ref[:, :] * onehot, axis=1, keepdims=True)
+    ma_col = jnp.sum(ma_ref[:, :] * onehot, axis=1, keepdims=True)
+    d = a_col - b_ref[:, :]                             # [Tp, Tk]
+    row = jnp.sum(g(d) * mb_ref[:, :], axis=1, keepdims=True)
+    x = jnp.sum(row * ma_col)
+    s = o_ref[0:1, :]                                   # [1, C]
+    comp = o_ref[1:2, :]
+    m = lax.broadcasted_iota(jnp.int32, s.shape, 1) == c
+    y = jnp.where(m, x - comp, 0.0)
+    t = s + y                                           # exact off-lane
+    o_ref[1:2, :] = jnp.where(m, (t - s) - y, comp)
+    o_ref[0:1, :] = t
+
+
+def _batched_masked_pair_sum(dpaT, dan, mjT, mk, *, combine: Kernel,
+                             tile_p: int, tile_k: int,
+                             interpret: bool):
+    """[C] per-anchor masked pair sums over the [P] x [K] grids.
+    dpaT: [P, C] positive distances (anchors in lanes), dan: [C, K]
+    negative distances, mjT: [P, C] per-anchor positive masks,
+    mk: [K] negative mask. P and K must be tile multiples (callers
+    pad with zero-mask rows)."""
+    P, C = dpaT.shape
+    K = dan.shape[1]
+    gp, gk = P // tile_p, K // tile_k
+    out = pl.pallas_call(
+        functools.partial(
+            _batched_pair_sum_kernel,
+            g=lambda d: combine.diff(d, jnp),
+        ),
+        out_shape=jax.ShapeDtypeStruct((2, C), jnp.float32),
+        grid=(gp, C, gk),
+        in_specs=[
+            pl.BlockSpec((tile_p, C), lambda i, c, j: (i, 0)),
+            pl.BlockSpec((1, tile_k), lambda i, c, j: (0, c * gk + j)),
+            pl.BlockSpec((tile_p, C), lambda i, c, j: (i, 0)),
+            pl.BlockSpec((1, tile_k), lambda i, c, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((2, C), lambda i, c, j: (0, 0)),
+        interpret=interpret,
+    )(dpaT, dan.reshape(1, C * K), mjT, mk.reshape(1, K))
+    # true per-anchor sum folds in the compensation lane
+    return out[0, :] - out[1, :]
+
+
 def pallas_triplet_stats(
     kernel: Kernel,
     X: jnp.ndarray,
@@ -95,13 +200,14 @@ def pallas_triplet_stats(
     positives: Optional[jnp.ndarray] = None,
     mask_p: Optional[jnp.ndarray] = None,
     ids_p: Optional[jnp.ndarray] = None,
-    anchor_chunk: int = 512,
+    anchor_chunk: int = 0,
     tile_p: int = 512,
-    tile_k: int = 4096,
+    tile_k: int = 0,
     interpret: bool = False,
 ):
-    # defaults measured on v5e at n=4096, d=32: 3.51e11 triplets/s
-    # (XLA tile scan: 1.0e11); wider k-tiles (8192) drop to 2.5e11
+    # anchor_chunk=0 / tile_k=0 resolve via the preferred_* dispatch
+    # (HBM-aware chunk; K-dependent lane tile) — regression-tested in
+    # tests/test_pallas_and_rank.py
     """(sum, count) of h(x_i, p_j, y_k) over ids_x[i] != ids_p[j] — the
     same contract as ops.pair_tiles.triplet_stats, at pair-kernel rate.
 
@@ -116,7 +222,6 @@ def pallas_triplet_stats(
             "factorization; use pair_tiles.triplet_stats"
         )
     from tuplewise_tpu.ops.pair_tiles import _pad_axis0
-    from tuplewise_tpu.ops.pallas_pairs import pallas_masked_pair_sum
 
     dtype = X.dtype
     mx = jnp.ones(X.shape[0], dtype) if mask_x is None else mask_x
@@ -131,8 +236,33 @@ def pallas_triplet_stats(
         ip = (jnp.arange(positives.shape[0]) if ids_p is None else ids_p
               ).astype(jnp.int32)
 
-    # clamp the measured-best shapes down for small inputs: the pair
-    # kernel pads every side up to a full tile, so tiles far beyond the
+    # Segment the positive/negative dims at 32768: a P or K of 65536
+    # reproducibly crashes the v5e TPU worker (kernel fault through the
+    # runtime, r5 — 32768 sustains 9.6e11 tr/s), and the grid partition
+    # is EXACT (per-anchor sums and counts are additive over P x K
+    # tiles; only the O(n^2 d) dan assembly is recomputed per positive
+    # segment, invisible against the O(n^3) combine).
+    _SEG = 32768
+    if positives.shape[0] > _SEG or Y.shape[0] > _SEG:
+        s_tot = jnp.zeros((), jnp.float32)
+        c_tot = jnp.zeros((), jnp.float32)
+        for p0 in range(0, positives.shape[0], _SEG):
+            p1 = min(p0 + _SEG, positives.shape[0])
+            for k0 in range(0, Y.shape[0], _SEG):
+                k1 = min(k0 + _SEG, Y.shape[0])
+                s, c = pallas_triplet_stats(
+                    kernel, X, Y[k0:k1], mask_x=mx, mask_y=my[k0:k1],
+                    ids_x=ix, positives=positives[p0:p1],
+                    mask_p=mp_[p0:p1], ids_p=ip[p0:p1],
+                    anchor_chunk=anchor_chunk, tile_p=tile_p,
+                    tile_k=tile_k, interpret=interpret,
+                )
+                s_tot = s_tot + s.astype(jnp.float32)
+                c_tot = c_tot + c.astype(jnp.float32)
+        return s_tot.astype(dtype), c_tot.astype(dtype)
+
+    # clamp the measured-best shapes down for small inputs: the batched
+    # kernel pads P/K up to tile multiples, so tiles far beyond the
     # data would spend almost all lanes on zero-mask padding (the same
     # rule as mesh_mc._clamp_preferred; interpret-mode tests at n~50
     # would otherwise emulate 512x4096 grids of padding)
@@ -141,6 +271,12 @@ def pallas_triplet_stats(
             t //= 2
         return t
 
+    if not anchor_chunk:
+        anchor_chunk = preferred_anchor_chunk(
+            positives.shape[0], Y.shape[0]
+        )
+    if not tile_k:
+        tile_k = preferred_triplet_tile_k(Y.shape[0])
     C = _clamp(anchor_chunk, X.shape[0], 8)
     tile_p = _clamp(tile_p, positives.shape[0], 8)
     tile_k = _clamp(tile_k, Y.shape[0], 128)
@@ -149,25 +285,33 @@ def pallas_triplet_stats(
     # padded anchors must not collide with any positive id: ids are
     # nonnegative, so -1 never matches
     ixc = _pad_axis0(ix + 1, C).reshape(-1, C) - 1
-
-    def per_anchor(dap, dan, mj):
-        s = pallas_masked_pair_sum(
-            dap, dan, mj, my, kernel=combine,
-            tile_a=tile_p, tile_b=tile_k, interpret=interpret,
-        )
-        return s, jnp.sum(mj) * jnp.sum(my)
+    # pad positives/negatives ONCE to tile multiples with zero masks:
+    # inside the chunk loop every shape is then tile-exact
+    pos_p, mp_p = _pad_axis0(positives, tile_p), _pad_axis0(mp_, tile_p)
+    ip_p = _pad_axis0(ip + 1, tile_p) - 1
+    Y_p, my_p = _pad_axis0(Y, tile_k), _pad_axis0(my, tile_k)
+    my_row = my_p.astype(jnp.float32)
 
     def chunk_stats(args):
         a, ma, ia = args
-        dap = _sqdist_matrix(a, positives)          # [C, P] MXU
-        dan = _sqdist_matrix(a, Y)                  # [C, K] MXU
-        mj = (mp_[None, :]
-              * (ia[:, None] != ip[None, :]).astype(dtype))  # [C, P]
-        s, c = jax.vmap(per_anchor)(dap, dan, mj)
-        return jnp.sum(s * ma), jnp.sum(c * ma)
+        # anchors in LANES: D_pa arrives [P, C] (its per-anchor columns
+        # are natural (8, 128) blocks for the batched kernel), D_an
+        # [C, K] — both one MXU assembly each
+        dpaT = _sqdist_matrix(pos_p, a)             # [P, C] MXU
+        dan = _sqdist_matrix(a, Y_p)                # [C, K] MXU
+        mjT = (mp_p[:, None]
+               * (ip_p[:, None] != ia[None, :]).astype(dtype))  # [P, C]
+        s_anchor = _batched_masked_pair_sum(
+            dpaT, dan, mjT.astype(jnp.float32), my_row,
+            combine=combine, tile_p=tile_p, tile_k=tile_k,
+            interpret=interpret,
+        )
+        cnt = jnp.sum(mjT, axis=0) * jnp.sum(my)    # [C]
+        return (jnp.sum(s_anchor * ma, dtype=jnp.float32),
+                jnp.sum(cnt * ma, dtype=jnp.float32))
 
     # lax.map over anchor chunks bounds the live distance matrices at
-    # [C, max(P, K)] while the vmapped pair kernel fills the chip
+    # C * (P + K) floats while the batched kernel fills the chip
     s, c = lax.map(chunk_stats, (Xc, mxc, ixc))
     return jnp.sum(s).astype(dtype), jnp.sum(c).astype(dtype)
 
