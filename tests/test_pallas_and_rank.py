@@ -264,8 +264,9 @@ class TestTripletPreferredDispatch:
             preferred_anchor_chunk,
         )
 
-        # measured-best 256 wherever the distance matrices fit
-        assert preferred_anchor_chunk(4096, 4096) == 256
+        # small grids take the deep chunk; 256 wherever the big-grid
+        # distance matrices must fit
+        assert preferred_anchor_chunk(4096, 4096) == 1024
         assert preferred_anchor_chunk(16384, 16384) == 256
         assert preferred_anchor_chunk(65536, 65536) == 256
         # ~2 GB budget: C * (P + K) * 4 bytes bounded
@@ -298,6 +299,6 @@ class TestTripletPreferredDispatch:
         Y = jnp.asarray(rng.standard_normal((52, 4)) + 0.3, jnp.float32)
         s0, c0 = pallas_triplet_stats(k, X, Y, interpret=True)
         s1, c1 = pallas_triplet_stats(
-            k, X, Y, anchor_chunk=256, tile_k=4096, interpret=True
+            k, X, Y, anchor_chunk=1024, tile_k=4096, interpret=True
         )
         assert float(s0) == float(s1) and float(c0) == float(c1)

@@ -96,12 +96,18 @@ def preferred_anchor_chunk(n_pos: int, n_neg: int) -> int:
     [C, K] cost C * (P + K) * 4 bytes f32 (natural (8, 128) tiling —
     the r4 per-anchor vmap layout padded a unit lane dim 128x and
     OOM'd 16 GB HBM at C=1024, P=16384; the batched kernel removed
-    that). 256 is the measured-best chunk (1.00e12 tr/s at n=16384,
-    tk=8192 — ~4% over C=512); huge grids shrink C further to bound
+    that). Two measured regimes on v5e (tp=1024; the committed grid is
+    results/triplet_scaling.jsonl, produced through this dispatch):
+    small grids (max(P, K) <= 8192) take C=1024 — fewer chunk-assembly
+    passes lift n=4096 d=32 to 3.97e11 tr/s (C=256 ran ~25% slower in
+    the r5 tuning probes); larger grids take C=256 (n=16384 d=16 at
+    1.01e12, n=32768 d=32 at 1.05e12), shrinking further only to bound
     the matrices + remat copies inside ~2 GB."""
+    if max(n_pos, n_neg) <= 8192:
+        return 1024
     budget = 2 * (1 << 30)
     cap = budget // ((n_pos + n_neg) * 4 + 1)
-    c = 256   # measured-best on v5e (1.00e12 tr/s at n=16384, tk=8192)
+    c = 256
     while c > 8 and c > cap:
         c //= 2
     return c
@@ -201,7 +207,7 @@ def pallas_triplet_stats(
     mask_p: Optional[jnp.ndarray] = None,
     ids_p: Optional[jnp.ndarray] = None,
     anchor_chunk: int = 0,
-    tile_p: int = 512,
+    tile_p: int = 1024,
     tile_k: int = 0,
     interpret: bool = False,
 ):
