@@ -29,6 +29,18 @@ from tuplewise_tpu.harness.variance import (
 )
 
 
+def _add_budget_flags(p: argparse.ArgumentParser) -> None:
+    """The per-step budget/recording flags shared by the learning and
+    train subcommands — one definition, no drift."""
+    p.add_argument("--pairs-per-worker", type=int, default=None)
+    p.add_argument("--pair-design", default="swr",
+                   choices=["swr", "swor", "bernoulli"],
+                   help="per-step pair-budget design (ops.device_design)")
+    p.add_argument("--loss-every", type=int, default=1,
+                   help="record the surrogate loss every k steps; "
+                        "0 = loss-free (grad-only kernel off step 0)")
+
+
 def _add_variance_args(p: argparse.ArgumentParser) -> None:
     for f in dataclasses.fields(VarianceConfig):
         flag = "--" + f.name.replace("_", "-")
@@ -101,7 +113,7 @@ def main(argv=None) -> int:
     p.add_argument("--n-workers", type=int, default=32)
     p.add_argument("--repartition-every", type=int, default=10,
                    help="0 = never repartition")
-    p.add_argument("--pairs-per-worker", type=int, default=None)
+    _add_budget_flags(p)
     p.add_argument("--n-seeds", type=int, default=8)
     p.add_argument("--eval-every", type=int, default=20)
     p.add_argument("--n", type=int, default=1024,
@@ -117,8 +129,9 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=0.3)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--n-workers", type=int, default=1)
-    p.add_argument("--repartition-every", type=int, default=10)
-    p.add_argument("--pairs-per-worker", type=int, default=None)
+    p.add_argument("--repartition-every", type=int, default=10,
+                   help="0 = never repartition")
+    _add_budget_flags(p)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--n", type=int, default=8000)
     p.add_argument("--out", type=str, default=None)
@@ -183,7 +196,9 @@ def main(argv=None) -> int:
             kernel=args.kernel, lr=args.lr, steps=args.steps,
             n_workers=args.n_workers,
             repartition_every=args.repartition_every or NEVER,
-            pairs_per_worker=args.pairs_per_worker, seed=args.seed,
+            pairs_per_worker=args.pairs_per_worker,
+            pair_design=args.pair_design,
+            loss_every=args.loss_every or NEVER, seed=args.seed,
         )
         out = train_curves(
             scorer, scorer.init(args.seed), Xp, Xn, Xp_te, Xn_te, cfg,
@@ -204,6 +219,9 @@ def main(argv=None) -> int:
             TrainConfig, evaluate_auc, split_by_label, train_pairwise,
         )
         from tuplewise_tpu.models.scorers import LinearScorer
+        from tuplewise_tpu.models.sim_learner import (
+            NEVER, last_recorded_loss,
+        )
 
         if args.dataset == "adult":
             X, y, Xte, yte, meta = load_adult_splits(
@@ -223,8 +241,10 @@ def main(argv=None) -> int:
         cfg = TrainConfig(
             kernel=args.kernel, lr=args.lr, steps=args.steps,
             n_workers=args.n_workers,
-            repartition_every=args.repartition_every,
-            pairs_per_worker=args.pairs_per_worker, seed=args.seed,
+            repartition_every=args.repartition_every or NEVER,
+            pairs_per_worker=args.pairs_per_worker,
+            pair_design=args.pair_design,
+            loss_every=args.loss_every or NEVER, seed=args.seed,
         )
         params, hist = train_pairwise(
             scorer, p0, Xp, Xn, cfg,
@@ -240,8 +260,13 @@ def main(argv=None) -> int:
                 "auc_train": evaluate_auc(scorer, params, Xp, Xn),
                 "auc_test_before": evaluate_auc(scorer, p0, Xp_te, Xn_te),
                 "auc_test": evaluate_auc(scorer, params, Xp_te, Xn_te),
+                # last RECORDED loss (None = never recorded past step
+                # 0 or diverged — never a NaN JSON literal, and never
+                # an earlier finite value masking divergence)
                 "loss_first": float(hist["loss"][0]),
-                "loss_last": float(hist["loss"][-1]),
+                "loss_last": last_recorded_loss(
+                    hist["loss"], cfg.loss_every
+                ),
             },
             args.out,
         )

@@ -45,13 +45,22 @@ from tuplewise_tpu.utils.rng import fold, root_key
 NEVER = 1 << 30
 
 
-def _last_finite_loss_mean(loss) -> float | None:
-    """Seed-mean of the last step whose loss was RECORDED (loss_every
-    masks the rest to NaN); None when no step recorded."""
-    finite = np.where(np.isfinite(loss).all(axis=0))[0]
-    if finite.size == 0:
+def last_recorded_loss(loss, loss_every: int) -> float | None:
+    """Mean loss at the last step cfg.loss_every RECORDED — the ONE
+    copy of the summary rule shared by curve_record, the CLI, and the
+    throughput rows. Looks at the recording PATTERN (t % loss_every
+    == 0), not at finiteness: a masked step is skipped, but a recorded
+    step that diverged to NaN/inf returns None instead of silently
+    falling back to an earlier finite value (None in place of a number
+    is the divergence flag; a NaN literal would be invalid JSON)."""
+    loss = np.atleast_2d(np.asarray(loss))
+    steps = loss.shape[-1]
+    if steps == 0:
         return None
-    return float(loss[:, finite[-1]].mean())
+    k = max(int(loss_every), 1)
+    last = ((steps - 1) // k) * k
+    v = float(loss[..., last].mean())
+    return v if np.isfinite(v) else None
 
 
 def curve_record(cfg, out, n_seeds: int) -> dict:
@@ -91,10 +100,11 @@ def curve_record(cfg, out, n_seeds: int) -> dict:
         "final_auc_mean": float(fin.mean()),
         "final_auc_se": final_se,
         "final_auc_sd": final_sd,
-        # last RECORDED loss: with cfg.loss_every > 1 trailing steps
-        # carry NaN, and a NaN here would be the invalid-JSON case the
-        # docstring forbids
-        "loss_final_mean": _last_finite_loss_mean(out["loss"]),
+        # last RECORDED loss (None = never recorded or diverged; a NaN
+        # here would be the invalid-JSON case the docstring forbids)
+        "loss_final_mean": last_recorded_loss(
+            out["loss"], cfg.loss_every
+        ),
     }
 
 
