@@ -263,15 +263,26 @@ def config4(out, q):
 
     def rate_at(nt, d, reps):
         """Complete-triplet throughput at one (n, d) shape — distinct
-        inputs per rep + host-read sync (the bench.py discipline)."""
+        inputs per rep + host-read sync (the bench.py discipline).
+        Inputs are made DEVICE-RESIDENT before the timed window: a
+        numpy input would put an [nt, d] host->device tunnel transfer
+        inside the clock (8.4 MB at d=128 — it depressed the r5 d=128
+        row ~35% until caught against resident-input probes)."""
+        import jax.numpy as jnp
+
         inputs = [
-            (rng.standard_normal((nt, d)).astype(np.float32),
-             rng.standard_normal((nt, d)).astype(np.float32) + 0.3)
-            for _ in range(reps)
+            (jnp.asarray(rng.standard_normal((nt, d)).astype(np.float32)),
+             jnp.asarray(rng.standard_normal((nt, d)).astype(np.float32)
+                         + 0.3))
+            for _ in range(reps + 1)
         ]
+        for X, Y in inputs:                 # force residency
+            float(jnp.sum(X) + jnp.sum(Y))
         est_t.complete(*inputs[0])          # compile outside the timer
         times = []
-        for X, Y in inputs:
+        # the warm pair never re-enters the timed loop: the runtime can
+        # memoize an identical repeated call (bench.py discipline)
+        for X, Y in inputs[1:]:
             t1 = time.perf_counter()
             est_t.complete(X, Y)            # float() inside = synced
             times.append(time.perf_counter() - t1)
@@ -323,9 +334,15 @@ def config4(out, q):
                         c_tot += float(c)
             return s_tot, c_tot
 
-        # warm: one sub-program compiles the (only) shape
-        sub(Xd[:seg], ids[:seg], Xd[:2 * seg], ids[:2 * seg],
-            Yd[:2 * seg])
+        # warm: one sub-program compiles the (only) shape — with
+        # SWAPPED operands so it matches no timed subcall (the runtime
+        # can memoize an identical repeated call), SYNCED by host read
+        # (async dispatch would otherwise leave ~17 s of warm device
+        # time running inside the timed window; block_until_ready is
+        # unreliable through this tunnel)
+        ws, wc = sub(Yd[:seg], ids[:seg], Yd[:2 * seg], ids[:2 * seg],
+                     Xd[:2 * seg])
+        float(ws), float(wc)
         t1 = time.perf_counter()
         s_tot, c_tot = run_all()
         dt_all = time.perf_counter() - t1
