@@ -272,6 +272,16 @@ def main():
             trace_dir=_out("trace_mesh_repart"))
         run(dataclasses.replace(mesh6, scheme="local"), "mesh_n1e6.jsonl",
             chunk=None if q else 4)
+        # designed incomplete THROUGH THE MESH at scale [VERDICT r4
+        # next #6 evidence]: distinct tuple sets drawn on device per
+        # rep (ops.device_design), sharded [N, per], cross-shard
+        # regather + psum'd weighted mean — zero host syncs in the
+        # rep loop; the swr row prices the design's extra cost
+        for design in ("swr", "swor"):
+            run(dataclasses.replace(
+                    mesh6, scheme="incomplete", n_pairs=100_000,
+                    design=design, n_reps=8 if q else 50),
+                "mesh_n1e6.jsonl", chunk=None if q else 10)
         # HBM high-water of the mesh stage (devices that report it)
         from tuplewise_tpu.utils.profiling import device_memory_stats
 
