@@ -360,7 +360,7 @@ def config4(out, q):
     grid = ([(256, 8, 3)] if q else [
         (4096, 16, 3), (4096, 32, 3), (4096, 128, 3),
         (16384, 16, 2), (16384, 32, 2), (16384, 128, 2),
-        (32768, 32, 1),
+        (32768, 16, 1), (32768, 32, 1), (32768, 128, 1),
         (65536, 32, 1),
     ])
     scale_rows = []

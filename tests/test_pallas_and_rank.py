@@ -283,6 +283,26 @@ class TestTripletPreferredDispatch:
         assert preferred_triplet_tile_k(16384) == 8192
         assert preferred_triplet_tile_k(65536) == 8192
 
+    def test_segmented_path_matches_unsegmented(self, monkeypatch):
+        """The P/K segmentation (the large-n path the v5e worker limit
+        forces) is an EXACT partition: shrinking _SEG so a small input
+        crosses it must reproduce the unsegmented statistic bit-for-bit
+        — including ragged segment tails and the id exclusion."""
+        import jax.numpy as jnp
+
+        from tuplewise_tpu.ops import pallas_triplets as pt
+        from tuplewise_tpu.ops.kernels import get_kernel
+
+        k = get_kernel("triplet_indicator")
+        rng = np.random.default_rng(9)
+        X = jnp.asarray(rng.standard_normal((50, 4)), jnp.float32)
+        Y = jnp.asarray(rng.standard_normal((43, 4)) + 0.3, jnp.float32)
+        s0, c0 = pt.pallas_triplet_stats(k, X, Y, interpret=True)
+        monkeypatch.setattr(pt, "_SEG", 24)   # 50 -> 24+24+2, 43 -> 24+19
+        s1, c1 = pt.pallas_triplet_stats(k, X, Y, interpret=True)
+        assert float(c0) == float(c1) == 50 * 49 * 43
+        assert float(s0) == float(s1)
+
     def test_auto_dispatch_matches_explicit(self):
         """anchor_chunk=0 / tile_k=0 resolve to the preferred values
         and produce the exact same statistic (interpret mode)."""

@@ -77,6 +77,13 @@ def triplet_combine_kernel(kernel: Kernel) -> Optional[Kernel]:
     return _combine_kernel(kernel.triplet_fn, margin, kind == "indicator")
 
 
+# positive/negative-dim segment bound for one batched-kernel call: a P
+# or K of 65536 reproducibly crashes the v5e TPU worker (r5; 32768
+# sustains ~1e12 tr/s), and the grid partition is exact — module-level
+# so tests can shrink it to pin the segmented path's parity
+_SEG = 32768
+
+
 def _sqdist_matrix(a, b):
     """[C, m] squared euclidean distances via the MXU contraction.
     Precision.HIGHEST: the default TPU matmul rounds operands to bf16,
@@ -242,13 +249,11 @@ def pallas_triplet_stats(
         ip = (jnp.arange(positives.shape[0]) if ids_p is None else ids_p
               ).astype(jnp.int32)
 
-    # Segment the positive/negative dims at 32768: a P or K of 65536
-    # reproducibly crashes the v5e TPU worker (kernel fault through the
-    # runtime, r5 — 32768 sustains 9.6e11 tr/s), and the grid partition
-    # is EXACT (per-anchor sums and counts are additive over P x K
-    # tiles; only the O(n^2 d) dan assembly is recomputed per positive
-    # segment, invisible against the O(n^3) combine).
-    _SEG = 32768
+    # Segment the positive/negative dims at _SEG (see its comment):
+    # the grid partition is EXACT (per-anchor sums and counts are
+    # additive over P x K tiles; only the O(n^2 d) dan assembly is
+    # recomputed per positive segment, invisible against the O(n^3)
+    # combine).
     if positives.shape[0] > _SEG or Y.shape[0] > _SEG:
         s_tot = jnp.zeros((), jnp.float32)
         c_tot = jnp.zeros((), jnp.float32)
