@@ -3,6 +3,16 @@
 Prints ONE JSON line:
   {"metric": "pairs/sec/chip", "value": N, "unit": "pairs/s", "vs_baseline": R}
 
+``--streaming`` switches to the serving-path benchmark instead: replay
+a synthetic stream through the micro-batched request engine
+(tuplewise_tpu.serving) and print ONE JSON line
+  {"metric": "events/sec", "value": N, "unit": "events/s",
+   "vs_baseline": R, ...}
+where vs_baseline is the dynamic batcher's speedup over the same
+engine forced to max_batch=1 (no coalescing) — the quantity the
+micro-batching exists to improve. Latency percentiles and the
+exact-vs-oracle parity check ride along in the same record.
+
 `value` is the complete-AUC pair-kernel throughput of the JAX/TPU tiled
 reduction on one chip (BASELINE.json:2's metric). The reference repo
 published no numbers (/root/reference was empty; BASELINE.md), so per
@@ -203,7 +213,80 @@ def _numpy_pairs_per_sec(n=16384, reps=3):
     return (n * n) / dt
 
 
+def _streaming_events_per_sec(n_events=20_000, budget=64, max_batch=256,
+                              window=None, baseline_events=2_000):
+    """Micro-batched serving throughput + unbatched baseline.
+
+    Policy "block" so every event is applied (throughput of the full
+    stream, not of the survivors); the baseline measures the same
+    per-event request path with coalescing disabled, on a shorter
+    stream (per-event cost dominates, so the rate is length-stable).
+    """
+    from tuplewise_tpu.serving import ServingConfig, make_stream, replay
+
+    scores, labels = make_stream(n_events, pos_frac=0.5, separation=1.0,
+                                 seed=0)
+    cfg = ServingConfig(budget=budget, max_batch=max_batch, window=window,
+                        policy="block", flush_timeout_s=0.002)
+    rec = replay(scores, labels, config=cfg, warmup=True)
+    print(
+        f"[bench] streaming n={n_events} batched: "
+        f"{rec['events_per_s']:.0f} ev/s p99={rec['latency_p99_ms']:.1f}ms "
+        f"fill={rec['mean_batch_fill']:.2f} "
+        f"auc_err={rec.get('auc_abs_err')}", file=sys.stderr,
+    )
+    nb = min(baseline_events, n_events)
+    base_cfg = ServingConfig(budget=budget, max_batch=1, window=window,
+                             policy="block", flush_timeout_s=0.0)
+    base = replay(scores[:nb], labels[:nb], config=base_cfg, warmup=True)
+    print(
+        f"[bench] streaming baseline (max_batch=1, n={nb}): "
+        f"{base['events_per_s']:.0f} ev/s", file=sys.stderr,
+    )
+    return rec, base
+
+
+def _streaming_main(args):
+    rec, base = _streaming_events_per_sec(
+        n_events=args.n_events, budget=args.budget,
+        max_batch=args.max_batch, window=args.window,
+        baseline_events=args.baseline_events,
+    )
+    out = {
+        "metric": "events/sec",
+        "value": round(rec["events_per_s"], 1),
+        "unit": "events/s",
+        "vs_baseline": round(rec["events_per_s"] / base["events_per_s"], 2),
+        "vs_baseline_note": (
+            "same request path with the dynamic batcher disabled "
+            "(max_batch=1): the coalescing speedup, like-for-like"
+        ),
+        "latency_p50_ms": rec["latency_p50_ms"],
+        "latency_p99_ms": rec["latency_p99_ms"],
+        "mean_batch_fill": rec["mean_batch_fill"],
+        "auc_abs_err": rec.get("auc_abs_err"),
+        "n_events": rec["n_events"],
+    }
+    print(json.dumps(out))
+
+
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--streaming", action="store_true",
+                    help="benchmark the micro-batched serving path "
+                         "instead of the batch pair kernel")
+    ap.add_argument("--n-events", type=int, default=20_000)
+    ap.add_argument("--budget", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--window", type=int, default=None)
+    ap.add_argument("--baseline-events", type=int, default=2_000)
+    args = ap.parse_args()
+    if args.streaming:
+        _streaming_main(args)
+        return
+
     tpu = _tpu_pairs_per_sec()
     rec = {
         "metric": "pairs/sec/chip",

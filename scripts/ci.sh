@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the exact command ROADMAP.md pins as the regression
+# gate, runnable locally or in CI. Forces the CPU platform; conftest.py
+# adds --xla_force_host_platform_device_count=8 so the mesh/ring paths
+# run on 8 virtual devices with no TPU attached.
+#
+# Usage: scripts/ci.sh
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

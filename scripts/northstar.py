@@ -86,18 +86,19 @@ def main():
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--stages", type=str,
                     default="1e6,1e7,tradeoff,designs,mesh,exact,scale8,"
-                            "figs",
+                            "serve,figs",
                     help="comma list of stages to run (the default runs "
                          "everything RESULTS.md commits: the production "
                          "scales, the visible-trade-off regime, the "
                          "sampling-design rows, the mesh ring, the exact "
-                         "rank-AUC series, and the n=10^8 scale demo)")
+                         "rank-AUC series, the n=10^8 scale demo, and "
+                         "the serving replay)")
     args = ap.parse_args()
     global QUICK
     QUICK = args.quick
     stages = set(args.stages.split(","))
     known = {"1e6", "1e7", "tradeoff", "designs", "mesh", "exact",
-             "scale8", "figs"}
+             "scale8", "serve", "figs"}
     if stages - known:
         ap.error(f"unknown stages {sorted(stages - known)}; "
                  f"choose from {sorted(known)}")
@@ -418,6 +419,39 @@ def main():
             run(dataclasses.replace(
                     base8, scheme="incomplete", n_pairs=B),
                 "pairs_n1e8.jsonl", chunk=None if q else 4)
+
+    if "serve" in stages:
+        # Online serving replay [ISSUE 1]: the micro-batched request
+        # path over the streaming estimators. One row per configuration
+        # cell: events/s, latency percentiles, batch fill, and the
+        # exact-vs-oracle parity guardrail, to results/serving.jsonl.
+        # Budget sweep exposes the ONLINE variance-vs-budget knob;
+        # max_batch 1 row prices the coalescing the engine exists for.
+        from tuplewise_tpu.serving import ServingConfig
+        from tuplewise_tpu.serving.replay import make_stream, replay
+
+        nS = 2_000 if q else 100_000
+        log(f"== stage serve (replay, n_events={nS}) ==")
+        sS, lS = make_stream(nS, pos_frac=0.5, separation=1.0, seed=0)
+        path = _out("serving.jsonl")
+        if os.path.exists(path):
+            os.remove(path)
+        cells = [
+            {"max_batch": 256, "budget": 64},
+            {"max_batch": 256, "budget": 4},
+            {"max_batch": 256, "budget": 64, "window": nS // 4},
+            {"max_batch": 1, "budget": 64},          # unbatched baseline
+        ]
+        for cell in cells:
+            cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
+                                **cell)
+            rec = replay(sS, lS, config=cfg, warmup=not q)
+            rec["stage"] = "serve"
+            write_jsonl([rec], path)
+            log(f"serve {cell}: {rec['events_per_s']:.0f} ev/s "
+                f"p99={rec['latency_p99_ms']:.1f}ms "
+                f"fill={rec['mean_batch_fill']:.2f} "
+                f"auc_err={rec.get('auc_abs_err')}")
 
     if "figs" in stages:
         log("== stage figures ==")

@@ -1,0 +1,36 @@
+"""bench.py output contract: the driver parses stdout as EXACTLY one
+JSON line carrying the headline record [ISSUE 1 satellite].
+
+Runs the streaming mode (tiny n — the batch mode's n=2^20 kernel
+benchmark is not a unit-test-sized workload); diagnostics must stay on
+stderr.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_streaming_bench_emits_one_json_line():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--streaming",
+         "--n-events", "400", "--baseline-events", "100",
+         "--max-batch", "32"],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be exactly one line: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, f"missing {key!r} in {rec}"
+    assert rec["metric"] == "events/sec"
+    assert rec["unit"] == "events/s"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+    # parity guardrail rides in the same record
+    assert rec["auc_abs_err"] < 1e-6

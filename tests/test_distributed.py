@@ -154,6 +154,16 @@ def test_two_process_ring_matches_oracle(tmp_path):
             for q in procs:
                 q.kill()
             pytest.fail("distributed smoke test timed out")
+        if (p.returncode != 0
+                and "Multiprocess computations aren't implemented"
+                in err):
+            # this jaxlib's CPU collective backend cannot run
+            # cross-process programs at all — environmental, not a
+            # regression in the ring (the single-process hierarchical
+            # ring is covered by test_mesh_2d)
+            for q in procs:
+                q.kill()
+            pytest.skip("jaxlib CPU backend lacks multiprocess support")
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out)
 

@@ -4,7 +4,12 @@ import os
 
 import numpy as np
 
+import pytest
+
 from tuplewise_tpu.utils.profiling import (
+    Counter,
+    Histogram,
+    MetricsRegistry,
     annotate,
     device_memory_stats,
     timer,
@@ -55,3 +60,100 @@ def test_harness_threads_trace_dir(tmp_path):
     res = run_variance_experiment(cfg, trace_dir=d)
     assert res["trace_dir"] == d
     assert np.isfinite(res["mean"])
+
+
+class TestCounter:
+    def test_inc_and_value(self):
+        c = Counter("requests")
+        c.inc()
+        c.inc(41)
+        assert c.value == 42
+        assert c.snapshot() == {"type": "counter", "value": 42}
+
+    def test_negative_inc_rejected(self):
+        c = Counter("requests")
+        with pytest.raises(ValueError, match="negative"):
+            c.inc(-1)
+
+    def test_thread_safety(self):
+        import threading
+
+        c = Counter("n")
+        threads = [
+            threading.Thread(target=lambda: [c.inc() for _ in range(1000)])
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+
+
+class TestHistogram:
+    def test_observe_count_sum_minmax(self):
+        h = Histogram("lat")
+        for v in (0.001, 0.002, 0.01):
+            h.observe(v)
+        assert h.count == 3
+        assert h.sum == pytest.approx(0.013)
+        snap = h.snapshot()
+        assert snap["min"] == 0.001 and snap["max"] == 0.01
+        assert snap["mean"] == pytest.approx(0.013 / 3)
+        assert sum(snap["buckets"].values()) == 3
+
+    def test_quantiles_exact_on_small_samples(self):
+        h = Histogram("q")
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.quantile(0.0) == 1.0
+        assert h.quantile(1.0) == 100.0
+        assert h.quantile(0.5) == pytest.approx(50.5)
+        assert h.quantile(0.99) == pytest.approx(99.01)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_empty_quantile_is_none(self):
+        h = Histogram("q")
+        assert h.quantile(0.5) is None
+        assert h.snapshot()["p99"] is None
+        assert h.mean() is None
+
+    def test_sample_window_bounds_memory(self):
+        h = Histogram("q", max_samples=16)
+        for v in range(1000):
+            h.observe(float(v))
+        assert h.count == 1000
+        assert len(h._samples) == 16
+        # the window holds the most recent values
+        assert h.quantile(0.0) >= 984.0
+
+    def test_bucket_edges(self):
+        h = Histogram("b", buckets=[1.0, 10.0])
+        for v in (0.5, 1.0, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["buckets"]["1.0"] == 2      # 0.5 and the exact 1.0
+        assert snap["buckets"]["10.0"] == 1     # 5.0
+        assert snap["buckets"]["+inf"] == 1     # 50.0
+
+
+class TestMetricsRegistry:
+    def test_create_or_return(self):
+        r = MetricsRegistry()
+        assert r.counter("a") is r.counter("a")
+        assert r.histogram("h") is r.histogram("h")
+
+    def test_type_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("x")
+        with pytest.raises(TypeError, match="already registered"):
+            r.histogram("x")
+
+    def test_snapshot_all(self):
+        r = MetricsRegistry()
+        r.counter("c").inc(3)
+        r.histogram("h").observe(0.5)
+        snap = r.snapshot()
+        assert snap["c"]["value"] == 3
+        assert snap["h"]["count"] == 1

@@ -1,0 +1,177 @@
+"""Micro-batch engine: ordering, coalescing, backpressure, metrics."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.serving import (
+    BackpressureError, MicroBatchEngine, ServingConfig,
+)
+from tuplewise_tpu.serving.replay import make_stream, replay
+
+
+def _cfg(**kw):
+    kw.setdefault("engine", "numpy")   # host counting: fast, no compiles
+    kw.setdefault("policy", "block")
+    return ServingConfig(**kw)
+
+
+class TestRequestPath:
+    def test_insert_then_query_sees_events(self):
+        with MicroBatchEngine(_cfg()) as eng:
+            eng.insert([1.0, 2.0, 0.5], [1, 1, 0]).result(10)
+            snap = eng.query().result(10)
+        assert snap["index"]["n_events"] == 3
+        assert snap["auc_exact"] == 1.0
+
+    def test_score_matches_index(self):
+        scores, labels = make_stream(400, seed=1)
+        with MicroBatchEngine(_cfg()) as eng:
+            eng.insert(scores, labels).result(10)
+            ranks = eng.score([0.0, 1.0]).result(10)
+            direct = eng.index.score_batch([0.0, 1.0])
+        np.testing.assert_allclose(ranks, direct, atol=0)
+
+    def test_coalescing_preserves_kind_order(self):
+        # a query issued AFTER an insert must observe it, even when both
+        # land in the same micro-batch
+        with MicroBatchEngine(_cfg(flush_timeout_s=0.05,
+                                   max_batch=64)) as eng:
+            futs = []
+            for i in range(10):
+                futs.append(eng.insert([float(i)], [i % 2]))
+                futs.append(eng.query())
+            results = [f.result(10) for f in futs]
+        for i in range(10):
+            snap = results[2 * i + 1]
+            assert snap["index"]["n_events"] >= i + 1
+
+    def test_runs_split_consecutive_kinds(self):
+        reqs = []
+
+        class R:
+            def __init__(self, kind):
+                self.kind = kind
+        for k in ("insert", "insert", "score", "query", "query", "insert"):
+            reqs.append(R(k))
+        runs = MicroBatchEngine._runs(reqs)
+        assert [(k, len(rs)) for k, rs in runs] == [
+            ("insert", 2), ("score", 1), ("query", 2), ("insert", 1)]
+
+    def test_non_auc_kernel_has_no_index(self):
+        with MicroBatchEngine(_cfg(kernel="hinge")) as eng:
+            eng.insert([1.0, 0.0], [1, 0]).result(10)
+            with pytest.raises(ValueError, match="exact AUC index"):
+                eng.score([0.5]).result(10)
+            snap = eng.query().result(10)
+        assert "index" not in snap
+        assert "estimate_incomplete" in snap
+
+    def test_close_idempotent_and_rejects_after(self):
+        eng = MicroBatchEngine(_cfg())
+        eng.close()
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.insert([1.0], [1])
+
+
+class TestBackpressure:
+    def _stalled_engine(self, **kw):
+        """Engine whose batcher is busy long enough to fill the queue."""
+        eng = MicroBatchEngine(_cfg(**kw))
+        orig = eng._apply_inserts
+        release = threading.Event()
+
+        def slow(run):
+            release.wait(timeout=10.0)
+            orig(run)
+        eng._apply_inserts = slow
+        return eng, release
+
+    def test_reject_policy_raises_and_counts(self):
+        eng, release = self._stalled_engine(policy="reject", queue_size=4,
+                                            max_batch=1,
+                                            flush_timeout_s=0.0)
+        try:
+            eng.insert([0.0], [0])          # occupies the batcher
+            time.sleep(0.05)                # let the batcher pick it up
+            ok, rejected = 0, 0
+            for i in range(20):
+                try:
+                    eng.insert([float(i)], [i % 2])
+                    ok += 1
+                except BackpressureError:
+                    rejected += 1
+            assert rejected > 0
+            assert eng.metrics.snapshot()["rejected_total"]["value"] \
+                == rejected
+        finally:
+            release.set()
+            eng.close()
+
+    def test_drop_oldest_fails_stale_future(self):
+        eng, release = self._stalled_engine(policy="drop_oldest",
+                                            queue_size=2, max_batch=1,
+                                            flush_timeout_s=0.0)
+        try:
+            first = eng.insert([0.0], [0])
+            time.sleep(0.05)
+            futs = [eng.insert([float(i)], [i % 2]) for i in range(8)]
+            release.set()
+            outcomes = []
+            for f in futs:
+                try:
+                    f.result(10)
+                    outcomes.append("ok")
+                except BackpressureError:
+                    outcomes.append("dropped")
+            assert "dropped" in outcomes
+            assert outcomes.count("ok") >= 1
+            assert first.result(10) == 1
+            assert eng.metrics.snapshot()["dropped_total"]["value"] \
+                == outcomes.count("dropped")
+        finally:
+            release.set()
+            eng.close()
+
+
+class TestReplayHarness:
+    def test_replay_reports_and_parity(self):
+        scores, labels = make_stream(1200, seed=6)
+        rec = replay(scores, labels, config=_cfg(max_batch=64,
+                                                 flush_timeout_s=0.001))
+        assert rec["events_applied"] == 1200
+        assert rec["events_per_s"] > 0
+        assert rec["latency_p99_ms"] is not None
+        assert rec["auc_abs_err"] < 1e-6
+        assert rec["batches"] >= 1200 / 64
+
+    def test_replay_windowed_parity(self):
+        scores, labels = make_stream(900, seed=8)
+        rec = replay(scores, labels,
+                     config=_cfg(window=250, max_batch=32,
+                                 flush_timeout_s=0.001))
+        assert rec["auc_abs_err"] < 1e-6
+        assert rec["index"]["n_evicted"] == 900 - 250
+
+    def test_replay_mixed_workload(self):
+        scores, labels = make_stream(600, seed=9)
+        rec = replay(scores, labels, config=_cfg(max_batch=32),
+                     score_every=5, query_every=7)
+        assert rec["events_applied"] == 600
+        assert rec["auc_abs_err"] < 1e-6
+
+    def test_metrics_snapshot_shape(self):
+        scores, labels = make_stream(300, seed=10)
+        with MicroBatchEngine(_cfg(max_batch=16)) as eng:
+            for i in range(0, 300, 3):
+                eng.insert(scores[i:i + 3], labels[i:i + 3])
+            snap = eng.flush()
+        m = snap["metrics"]
+        assert m["events_total"]["value"] == 300
+        assert m["batches_total"]["value"] >= 1
+        assert m["request_latency_s"]["count"] >= 100
+        assert 0 < m["batch_fill"]["mean"] <= 1.0
+        assert m["incomplete_pairs_total"]["value"] > 0

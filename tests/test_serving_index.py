@@ -1,0 +1,142 @@
+"""Streaming-vs-batch parity for the incremental exact-AUC index.
+
+The contract [ISSUE 1 acceptance]: after replaying any prefix of a
+stream, the incremental estimate equals the batch ``ops.rank_auc`` and
+the NumPy midrank oracle on that prefix within 1e-6, bit-stable across
+compaction boundaries, including with sliding-window eviction.
+"""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.models.metrics import auc_score
+from tuplewise_tpu.serving import ExactAucIndex
+from tuplewise_tpu.serving.replay import make_stream
+
+
+def _stream(n, seed=7, pos_frac=0.45):
+    scores, labels = make_stream(n, pos_frac=pos_frac, separation=1.0,
+                                 seed=seed)
+    # f32 values so the jax engine (f32 storage) and the f64 oracle see
+    # identical comparison outcomes
+    return scores.astype(np.float32), labels
+
+
+def _oracle(scores, labels):
+    pos, neg = scores[labels], scores[~labels]
+    if len(pos) == 0 or len(neg) == 0:
+        return None
+    return auc_score(pos.astype(np.float64), neg.astype(np.float64))
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+class TestPrefixParity:
+    def test_every_checkpointed_prefix(self, engine):
+        scores, labels = _stream(1500)
+        idx = ExactAucIndex(engine=engine, compact_every=96)
+        checkpoints = [1, 2, 7, 50, 96, 97, 200, 500, 777, 1024, 1500]
+        off = 0
+        for c in checkpoints:
+            idx.insert_batch(scores[off:c], labels[off:c])
+            off = c
+            oracle = _oracle(scores[:c], labels[:c])
+            if oracle is None:
+                assert idx.auc() is None
+            else:
+                assert idx.auc() == pytest.approx(oracle, abs=1e-6), c
+        assert idx.n_compactions > 0, "checkpoints must cross compactions"
+
+    def test_rank_auc_agrees(self, engine):
+        from tuplewise_tpu.ops.rank_auc import rank_auc
+
+        scores, labels = _stream(800, seed=3)
+        idx = ExactAucIndex(engine=engine, compact_every=64)
+        for i in range(0, 800, 37):
+            idx.insert_batch(scores[i:i + 37], labels[i:i + 37])
+            k = min(i + 37, 800)
+            pos, neg = scores[:k][labels[:k]], scores[:k][~labels[:k]]
+            if len(pos) and len(neg):
+                ra = float(rank_auc(pos, neg))
+                assert idx.auc() == pytest.approx(ra, abs=1e-6)
+
+    def test_bit_stable_across_compaction(self, engine):
+        scores, labels = _stream(600, seed=11)
+        # compact_every large: nothing compacts until we force it
+        idx = ExactAucIndex(engine=engine, compact_every=10_000)
+        idx.insert_batch(scores, labels)
+        before = idx.auc()
+        assert idx.n_compactions == 0
+        idx.compact()
+        assert idx.n_compactions > 0
+        assert idx.auc() == before  # exact bit equality, not approx
+
+    def test_window_eviction_tracks_tail_oracle(self, engine):
+        scores, labels = _stream(1200, seed=5)
+        W = 300
+        idx = ExactAucIndex(engine=engine, window=W, compact_every=48)
+        for i in range(0, 1200, 29):
+            k = min(i + 29, 1200)
+            idx.insert_batch(scores[i:k], labels[i:k])
+            tail_s, tail_l = scores[max(0, k - W):k], labels[max(0, k - W):k]
+            oracle = _oracle(tail_s, tail_l)
+            if oracle is not None:
+                assert idx.auc() == pytest.approx(oracle, abs=1e-6), k
+            assert idx.n_events == len(tail_s)
+        assert idx.n_evicted == 1200 - W
+        assert idx.n_compactions > 0
+
+    def test_window_smaller_than_one_batch(self, engine):
+        scores, labels = _stream(400, seed=9)
+        idx = ExactAucIndex(engine=engine, window=64)
+        idx.insert_batch(scores, labels)   # single batch >> window
+        oracle = _oracle(scores[-64:], labels[-64:])
+        assert idx.auc() == pytest.approx(oracle, abs=1e-6)
+        assert idx.n_events == 64
+
+
+class TestIndexBehavior:
+    def test_score_batch_is_rank_fraction(self):
+        scores, labels = _stream(500, seed=2)
+        idx = ExactAucIndex(engine="numpy")
+        idx.insert_batch(scores, labels)
+        neg = np.sort(scores[~labels])
+        q = np.asarray([-3.0, 0.0, 3.0], dtype=np.float32)
+        got = idx.score_batch(q)
+        want = (np.searchsorted(neg, q, side="left")
+                + 0.5 * (np.searchsorted(neg, q, side="right")
+                         - np.searchsorted(neg, q, side="left"))) / len(neg)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_duplicate_values_and_ties(self):
+        # heavy ties: values on a small integer grid
+        rng = np.random.default_rng(0)
+        scores = rng.integers(0, 4, size=600).astype(np.float32)
+        labels = rng.random(600) < 0.5
+        idx = ExactAucIndex(engine="numpy", window=200, compact_every=32)
+        for i in range(0, 600, 23):
+            idx.insert_batch(scores[i:i + 23], labels[i:i + 23])
+        oracle = _oracle(scores[-200:], labels[-200:])
+        assert idx.auc() == pytest.approx(oracle, abs=1e-9)
+
+    def test_rejects_non_finite(self):
+        idx = ExactAucIndex(engine="numpy")
+        with pytest.raises(ValueError, match="finite"):
+            idx.insert_batch([np.nan], [1])
+
+    def test_oracle_values_roundtrip(self):
+        scores, labels = _stream(300, seed=4)
+        idx = ExactAucIndex(engine="numpy", window=120, compact_every=16)
+        idx.insert_batch(scores, labels)
+        pos, neg = idx.oracle_values()
+        tail_s, tail_l = scores[-120:], labels[-120:]
+        np.testing.assert_array_equal(pos, np.sort(tail_s[tail_l]))
+        np.testing.assert_array_equal(neg, np.sort(tail_s[~tail_l]))
+
+    def test_empty_and_one_sided(self):
+        idx = ExactAucIndex(engine="numpy")
+        assert idx.auc() is None
+        idx.insert_batch([1.0, 2.0], [1, 1])
+        assert idx.auc() is None          # no negatives yet
+        assert np.isnan(idx.score_batch([0.5])).all()
+        idx.insert_batch([0.0], [0])
+        assert idx.auc() == 1.0

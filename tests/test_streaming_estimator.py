@@ -1,0 +1,102 @@
+"""StreamingEstimator facade + the online incomplete-U estimator."""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.estimators import StreamingEstimator
+from tuplewise_tpu.models.metrics import auc_score
+from tuplewise_tpu.serving import StreamingIncompleteU
+from tuplewise_tpu.serving.replay import make_stream
+
+
+class TestStreamingIncompleteU:
+    def test_estimate_tracks_auc(self):
+        scores, labels = make_stream(4000, seed=0)
+        est = StreamingIncompleteU(kernel="auc", budget=32, seed=1)
+        for i in range(0, 4000, 16):
+            est.extend(scores[i:i + 16], labels[i:i + 16])
+        truth = auc_score(scores[labels], scores[~labels])
+        assert est.estimate() == pytest.approx(truth, abs=0.02)
+        assert est.n_terms > 100_000
+
+    def test_budget_reduces_variance(self):
+        # The online variance-vs-budget trade-off [ISSUE 1 tentpole
+        # (2)], measured where it lives: CONDITIONAL on a fixed stream,
+        # the across-seed variance (partner-sampling randomness only)
+        # shrinks with the per-arrival budget. (The unconditional error
+        # has a budget-independent floor from the stream itself — same
+        # structure as the batch incomplete estimator's zeta_1 term.)
+        scores, labels = make_stream(800, seed=42)
+
+        def var_seeds(budget, n_seeds=12):
+            ests = []
+            for s in range(n_seeds):
+                est = StreamingIncompleteU(budget=budget, seed=s)
+                for i in range(0, 800, 8):
+                    est.extend(scores[i:i + 8], labels[i:i + 8])
+                ests.append(est.estimate())
+            return float(np.var(ests))
+
+        # 64x the budget measured ~0.03x the conditional variance;
+        # assert a conservative 5x reduction
+        assert var_seeds(64) < var_seeds(1) * 0.2
+
+    def test_swor_design_distinct_partners(self):
+        est = StreamingIncompleteU(budget=8, reservoir=8, design="swor",
+                                   seed=0)
+        est.extend(np.arange(8.0), np.zeros(8))       # fill neg reservoir
+        spent = est.extend([5.0], [1])
+        # swor caps at reservoir occupancy and draws distinct partners
+        assert spent == 8
+
+    def test_rejects_non_diff_kernel(self):
+        with pytest.raises(ValueError, match="score-difference"):
+            StreamingIncompleteU(kernel="scatter")
+
+    def test_reservoir_bounds_memory(self):
+        est = StreamingIncompleteU(budget=4, reservoir=64, seed=0)
+        scores, labels = make_stream(2000, seed=3)
+        est.extend(scores[:1000], labels[:1000])
+        est.extend(scores[1000:], labels[1000:])
+        st = est.state()
+        assert st["reservoir_pos"] <= 64 and st["reservoir_neg"] <= 64
+        assert st["n_arrivals"] == 2000
+
+
+class TestStreamingEstimatorFacade:
+    def test_exact_and_incomplete_agree_statistically(self):
+        scores, labels = make_stream(2000, seed=5)
+        se = StreamingEstimator("auc", budget=32, engine="numpy", seed=2)
+        for i in range(0, 2000, 25):
+            se.extend(scores[i:i + 25], labels[i:i + 25])
+        exact = se.auc()
+        truth = auc_score(scores[labels], scores[~labels])
+        assert exact == pytest.approx(truth, abs=1e-9)
+        assert se.estimate() == pytest.approx(exact, abs=0.03)
+        assert se.n_pos + se.n_neg == 2000
+
+    def test_windowed_facade(self):
+        scores, labels = make_stream(1000, seed=6)
+        se = StreamingEstimator("auc", window=200, engine="numpy")
+        for i in range(0, 1000, 11):
+            se.extend(scores[i:i + 11], labels[i:i + 11])
+        tail_s, tail_l = scores[-200:], labels[-200:]
+        truth = auc_score(tail_s[tail_l], tail_s[~tail_l])
+        assert se.auc() == pytest.approx(truth, abs=1e-9)
+
+    def test_non_auc_kernel_facade(self):
+        scores, labels = make_stream(500, seed=7)
+        se = StreamingEstimator("hinge", budget=16, seed=0)
+        for i in range(0, 500, 10):
+            se.extend(scores[i:i + 10], labels[i:i + 10])
+        assert se.auc() is None
+        assert se.estimate() is not None
+        with pytest.raises(ValueError, match="exact index"):
+            se.score([0.0])
+
+    def test_observe_single_events(self):
+        se = StreamingEstimator("auc", engine="numpy")
+        for s, l in ((1.0, 1), (0.0, 0), (2.0, 1)):
+            se.observe(s, l)
+        assert se.auc() == 1.0
+        assert se.state()["index"]["n_events"] == 3

@@ -20,7 +20,16 @@ Layer map (SURVEY §2):
   comm backend   -> tuplewise_tpu.parallel (mesh, ring collectives)
 """
 
+from tuplewise_tpu.utils.compat import (
+    ensure_lax_axis_size as _ensure_lax_axis_size,
+    ensure_shard_map as _ensure_shard_map,
+)
+
+_ensure_shard_map()
+_ensure_lax_axis_size()
+
 from tuplewise_tpu.estimators.estimator import Estimator
+from tuplewise_tpu.estimators.streaming import StreamingEstimator
 from tuplewise_tpu.ops.kernels import (
     Kernel,
     auc_kernel,
@@ -35,6 +44,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Estimator",
+    "StreamingEstimator",
     "Kernel",
     "auc_kernel",
     "hinge_kernel",

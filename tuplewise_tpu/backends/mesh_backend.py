@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tuplewise_tpu.utils.compat import sharded_take
 from tuplewise_tpu.backends.base import register_backend
 from tuplewise_tpu.ops import pair_tiles
 from tuplewise_tpu.ops.kernels import Kernel, get_kernel
@@ -230,15 +231,15 @@ class MeshBackend:
                 i2 = draw_blocks(k2, n2, scheme)
                 # cross-shard regather: XLA lowers this to the all-to-all
                 # shuffle that repartitioning prices [SURVEY §1.2 item 3]
-                Ab = A.at[i1].get(out_sharding=shard2)
-                Bb = B.at[i2].get(out_sharding=shard2)
+                Ab = sharded_take(A, i1, shard2)
+                Bb = sharded_take(B, i2, shard2)
                 vals = local_mean_smap(Ab, i1, Bb, i2)
             else:
                 # one-sample: ONE partition, same block and ids on both
                 # sides so coincident-id pairs are excluded exactly as in
                 # the oracle backend
                 i1 = draw_blocks(key, n1, scheme)
-                Ab = A.at[i1].get(out_sharding=shard2)
+                Ab = sharded_take(A, i1, shard2)
                 vals = local_mean_smap(Ab, i1, Ab, i1)
             alive = alive.astype(vals.dtype)
             return jnp.sum(vals * alive) / jnp.sum(alive)
@@ -352,9 +353,9 @@ class MeshBackend:
                     (i, j, kk), w, N, dtype=self.dtype
                 )
                 return designed_triplet_smap(
-                    Ag.at[pi].get(out_sharding=shard2),
-                    Ag.at[pj].get(out_sharding=shard2),
-                    Bg.at[pk].get(out_sharding=shard2),
+                    sharded_take(Ag, pi, shard2),
+                    sharded_take(Ag, pj, shard2),
+                    sharded_take(Bg, pk, shard2),
                     pw,
                 )
             one_sample = not k.two_sample
@@ -365,8 +366,8 @@ class MeshBackend:
             pi, pj, pw = shard_design_blocks((i, j), w, N,
                                              dtype=self.dtype)
             return designed_smap(
-                Ag.at[pi].get(out_sharding=shard2),
-                Bg.at[pj].get(out_sharding=shard2),
+                sharded_take(Ag, pi, shard2),
+                sharded_take(Bg, pj, shard2),
                 pw,
             )
 

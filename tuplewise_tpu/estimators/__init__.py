@@ -1,4 +1,5 @@
 from tuplewise_tpu.estimators.estimator import Estimator
+from tuplewise_tpu.estimators.streaming import StreamingEstimator
 from tuplewise_tpu.estimators.variance import (
     two_sample_zetas,
     two_sample_variance,
@@ -11,6 +12,7 @@ from tuplewise_tpu.estimators.variance import (
 
 __all__ = [
     "Estimator",
+    "StreamingEstimator",
     "two_sample_zetas",
     "two_sample_variance",
     "one_sample_zetas",

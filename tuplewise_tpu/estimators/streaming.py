@@ -1,0 +1,109 @@
+"""L3 — the streaming twin of ``Estimator``: one facade over the
+serving-layer state machines.
+
+``Estimator`` answers batch questions about arrays it is handed;
+``StreamingEstimator`` absorbs a stream of (score, label) events and
+answers at any time:
+
+* ``auc()``       — EXACT AUC of everything observed (or of the sliding
+                    window), via the incremental rank index — matches
+                    the batch ``rank_auc`` / NumPy oracle on the same
+                    prefix (serving/index.py).
+* ``estimate()``  — the budgeted incomplete-U estimate of the kernel
+                    mean (B pairs per arrival against reservoir
+                    history) — the paper's variance-vs-budget knob in
+                    the online regime (serving/streaming.py).
+
+It is synchronous and single-threaded (library use, tests, notebooks);
+the async micro-batched request path around the same state machines is
+``serving.MicroBatchEngine``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from tuplewise_tpu.serving.index import ExactAucIndex
+from tuplewise_tpu.serving.streaming import StreamingIncompleteU
+
+
+class StreamingEstimator:
+    """Online tuplewise estimator over a scored event stream.
+
+    Args:
+      kernel: two-sample score-difference kernel ("auc", "hinge",
+        "logistic"). The exact index exists only for "auc" (the
+        Mann-Whitney rank structure is what makes exactness cheap);
+        other kernels still get the incomplete estimate.
+      budget: incomplete-U pairs spent per arrival.
+      reservoir: per-class reservoir capacity for the incomplete path.
+      design: partner sampling design, "swr" or "swor".
+      window: sliding window in arrivals for the exact index;
+        None = unbounded.
+      engine: exact-index count/compaction engine, "jax" or "numpy".
+      seed: RNG seed for the incomplete path's partner draws.
+    """
+
+    def __init__(self, kernel: str = "auc", *, budget: int = 64,
+                 reservoir: int = 4096, design: str = "swr",
+                 window: Optional[int] = None, compact_every: int = 512,
+                 engine: str = "jax", seed: int = 0):
+        self.kernel_name = kernel if isinstance(kernel, str) else kernel.name
+        self.index = ExactAucIndex(
+            window=window, compact_every=compact_every, engine=engine,
+        ) if self.kernel_name == "auc" else None
+        self.streaming = StreamingIncompleteU(
+            kernel=kernel, budget=budget, reservoir=reservoir,
+            design=design, seed=seed,
+        )
+
+    # ------------------------------------------------------------------ #
+    def observe(self, score: float, label) -> None:
+        """One event: a score and its binary label (truthy = positive)."""
+        self.extend([score], [label])
+
+    def extend(self, scores, labels) -> None:
+        """A micro-batch of events, in arrival order."""
+        scores = np.asarray(scores, dtype=np.float64).ravel()
+        labels = np.asarray(labels).ravel().astype(bool)
+        if self.index is not None:
+            self.index.insert_batch(scores, labels)
+        self.streaming.extend(scores, labels)
+
+    # ------------------------------------------------------------------ #
+    def auc(self) -> Optional[float]:
+        """Exact AUC of the observed prefix/window; None before both
+        classes appear (or for non-AUC kernels)."""
+        return None if self.index is None else self.index.auc()
+
+    def estimate(self) -> Optional[float]:
+        """Budgeted incomplete-U estimate of the kernel mean."""
+        return self.streaming.estimate()
+
+    def score(self, scores) -> np.ndarray:
+        """Fractional rank of candidate scores against current
+        negatives (AUC kernel only)."""
+        if self.index is None:
+            raise ValueError("score() needs the exact index (kernel='auc')")
+        return self.index.score_batch(scores)
+
+    @property
+    def n_pos(self) -> int:
+        return self.index.n_pos if self.index is not None else \
+            self.streaming._pos.seen
+
+    @property
+    def n_neg(self) -> int:
+        return self.index.n_neg if self.index is not None else \
+            self.streaming._neg.seen
+
+    def state(self) -> dict:
+        out = {"kernel": self.kernel_name,
+               "streaming": self.streaming.state()}
+        if self.index is not None:
+            out["index"] = self.index.state()
+            out["auc"] = self.index.auc()
+        out["estimate"] = self.streaming.estimate()
+        return out

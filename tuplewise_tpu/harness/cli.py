@@ -7,9 +7,14 @@
     python -m tuplewise_tpu.harness.cli triplet --n 2000
     python -m tuplewise_tpu.harness.cli train --dataset adult --steps 100
     python -m tuplewise_tpu.harness.cli learning --n-workers 128 --repartition-every 25
+    python -m tuplewise_tpu.harness.cli replay --n-events 20000 --budget 64
+    echo '{"op":"insert","score":1.2,"label":1}' | python -m tuplewise_tpu.harness.cli serve
 
 Each command prints JSON to stdout and can append JSONL via --out
-[SURVEY §2 L6, §5.6].
+[SURVEY §2 L6, §5.6]. ``serve`` is the online service loop (JSONL
+request/response over stdin/stdout — transport-free so it runs
+anywhere; put a socket server in front for network serving); ``replay``
+is its benchmark twin (serving/replay.py).
 """
 
 from __future__ import annotations
@@ -18,6 +23,8 @@ import argparse
 import dataclasses
 import json
 import sys
+
+import numpy as np
 
 from tuplewise_tpu.harness.variance import (
     VarianceConfig,
@@ -66,6 +73,47 @@ def _emit(results, out):
         print(json.dumps(r))
     if out:
         write_jsonl(results, out)
+
+
+def _serve_stdin(cfg) -> int:
+    """The ``serve`` loop: one JSONL request per stdin line, one JSONL
+    response per stdout line (same order); final stats to stderr."""
+    from tuplewise_tpu.serving import BackpressureError, MicroBatchEngine
+
+    with MicroBatchEngine(cfg) as eng:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                op = req["op"]
+                if op == "insert":
+                    fut = eng.insert(req["score"], req["label"])
+                    resp = {"ok": True, "inserted": int(fut.result(30.0))}
+                elif op == "score":
+                    fut = eng.score(req["score"])
+                    ranks = fut.result(30.0)
+                    resp = {"ok": True,
+                            "rank": [None if np.isnan(r) else float(r)
+                                     for r in np.atleast_1d(ranks)]}
+                elif op == "query":
+                    snap = eng.query().result(30.0)
+                    resp = {"ok": True,
+                            "auc_exact": snap.get("auc_exact"),
+                            "estimate_incomplete":
+                                snap["estimate_incomplete"],
+                            "state": snap.get("index")}
+                else:
+                    resp = {"ok": False, "error": f"unknown op {op!r}"}
+            except BackpressureError as e:
+                resp = {"ok": False, "error": f"backpressure: {e}"}
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                resp = {"ok": False, "error": f"bad request: {e}"}
+            print(json.dumps(resp), flush=True)
+        stats = eng.stats()
+    print(json.dumps({"final_stats": stats["metrics"]}), file=sys.stderr)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -138,7 +186,76 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
 
+    def _add_serving_flags(p: argparse.ArgumentParser) -> None:
+        """ServingConfig knobs shared by serve and replay."""
+        p.add_argument("--kernel", default="auc")
+        p.add_argument("--budget", type=int, default=64,
+                       help="incomplete-U pairs per arrival")
+        p.add_argument("--reservoir", type=int, default=4096)
+        p.add_argument("--design", default="swr", choices=["swr", "swor"])
+        p.add_argument("--window", type=int, default=None,
+                       help="sliding window (arrivals); default unbounded")
+        p.add_argument("--compact-every", type=int, default=512)
+        p.add_argument("--engine", default="jax", choices=["jax", "numpy"],
+                       help="exact-index count/compaction engine")
+        p.add_argument("--max-batch", type=int, default=256)
+        p.add_argument("--flush-timeout-ms", type=float, default=2.0)
+        p.add_argument("--queue-size", type=int, default=1024)
+        p.add_argument("--policy", default="reject",
+                       choices=["reject", "drop_oldest", "block"])
+        p.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser(
+        "serve",
+        help="online service loop: JSONL requests on stdin "
+             '({"op":"insert","score":s,"label":l} | {"op":"score",'
+             '"score":s} | {"op":"query"}), JSONL responses on stdout',
+    )
+    _add_serving_flags(p)
+
+    p = sub.add_parser(
+        "replay",
+        help="replay a synthetic Gaussian stream through the "
+             "micro-batch engine; report events/s + latency percentiles",
+    )
+    _add_serving_flags(p)
+    p.add_argument("--n-events", type=int, default=20_000)
+    p.add_argument("--pos-frac", type=float, default=0.5)
+    p.add_argument("--separation", type=float, default=1.0)
+    p.add_argument("--chunk", type=int, default=1,
+                   help="events per insert request (1 = per-event)")
+    p.add_argument("--score-every", type=int, default=0)
+    p.add_argument("--query-every", type=int, default=0)
+    p.add_argument("--out", type=str, default=None)
+
     args = ap.parse_args(argv)
+
+    if args.cmd in ("serve", "replay"):
+        from tuplewise_tpu.serving import ServingConfig
+
+        cfg = ServingConfig(
+            kernel=args.kernel, budget=args.budget,
+            reservoir=args.reservoir, design=args.design,
+            window=args.window, compact_every=args.compact_every,
+            engine=args.engine, max_batch=args.max_batch,
+            flush_timeout_s=args.flush_timeout_ms / 1e3,
+            queue_size=args.queue_size, policy=args.policy,
+            seed=args.seed,
+        )
+        if args.cmd == "replay":
+            from tuplewise_tpu.serving import make_stream, replay
+
+            scores, labels = make_stream(
+                args.n_events, pos_frac=args.pos_frac,
+                separation=args.separation, seed=args.seed)
+            _emit(
+                replay(scores, labels, config=cfg, chunk=args.chunk,
+                       score_every=args.score_every,
+                       query_every=args.query_every),
+                args.out,
+            )
+            return 0
+        return _serve_stdin(cfg)
 
     if args.cmd == "variance":
         _emit(

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from tuplewise_tpu.utils.compat import sharded_take
 from tuplewise_tpu.ops.kernels import get_kernel
 
 
@@ -207,9 +208,9 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
                 )
                 pi, pj, pk, pw = shard_design_blocks((i, j, kk), w, N)
                 return designed_tri_smap(
-                    A.at[pi].get(out_sharding=shard2),
-                    A.at[pj].get(out_sharding=shard2),
-                    Bg.at[pk].get(out_sharding=shard2),
+                    sharded_take(A, pi, shard2),
+                    sharded_take(A, pj, shard2),
+                    sharded_take(Bg, pk, shard2),
                     pw,
                 )
             i, j, w = draw_pair_design_device(
@@ -218,8 +219,8 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
             )
             pi, pj, pw = shard_design_blocks((i, j), w, N)
             return designed_smap(
-                A.at[pi].get(out_sharding=shard2),
-                Bg.at[pj].get(out_sharding=shard2),
+                sharded_take(A, pi, shard2),
+                sharded_take(Bg, pj, shard2),
                 pw,
             )
 
@@ -336,14 +337,14 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
         never gathered and ragged sizes drop a random remainder."""
         if one_sample:
             i1 = draw_blocks(key, n1, N, cfg.partition_scheme)
-            Ab = s1.reshape((N * cap1,) + feat).at[i1].get(out_sharding=shard2)
+            Ab = sharded_take(s1.reshape((N * cap1,) + feat), i1, shard2)
             vals = local_mean_smap(Ab, Ab, i1, i1)
             return jnp.mean(vals)
         k1, k2 = jax.random.split(key)
         i1 = draw_blocks(k1, n1, N, cfg.partition_scheme)
         i2 = draw_blocks(k2, n2, N, cfg.partition_scheme)
-        Ab = s1.reshape((N * cap1,) + feat).at[i1].get(out_sharding=shard2)
-        Bb = s2.reshape((N * cap2,) + feat).at[i2].get(out_sharding=shard2)
+        Ab = sharded_take(s1.reshape((N * cap1,) + feat), i1, shard2)
+        Bb = sharded_take(s2.reshape((N * cap2,) + feat), i2, shard2)
         return jnp.mean(local_mean_smap(Ab, Bb, i1, i2))
 
     def incomplete_body(key, a, b):
@@ -376,13 +377,13 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
         kp, ks = jax.random.split(key)
         if one_sample:
             i1 = draw_blocks(kp, n1, N, "swor")
-            Ab = s1.reshape((N * cap1,) + feat).at[i1].get(out_sharding=shard2)
+            Ab = sharded_take(s1.reshape((N * cap1,) + feat), i1, shard2)
             return incomplete_smap(ks, Ab, Ab)
         k1, k2 = jax.random.split(kp)
         i1 = draw_blocks(k1, n1, N, "swor")
         i2 = draw_blocks(k2, n2, N, "swor")
-        Ab = s1.reshape((N * cap1,) + feat).at[i1].get(out_sharding=shard2)
-        Bb = s2.reshape((N * cap2,) + feat).at[i2].get(out_sharding=shard2)
+        Ab = sharded_take(s1.reshape((N * cap1,) + feat), i1, shard2)
+        Bb = sharded_take(s2.reshape((N * cap2,) + feat), i2, shard2)
         return incomplete_smap(ks, Ab, Bb)
 
     def one_rep(rep):

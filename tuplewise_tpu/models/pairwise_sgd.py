@@ -36,6 +36,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tuplewise_tpu.utils.compat import sharded_take
 from tuplewise_tpu.models.metrics import auc_score
 from tuplewise_tpu.ops import pair_tiles
 from tuplewise_tpu.ops.kernels import get_kernel
@@ -183,8 +184,8 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
             i1 = draw_blocks(k1, n1, m1)
             i2 = draw_blocks(k2, n2, m2)
             return (
-                Xp.at[i1].get(out_sharding=shard_blocks),
-                Xn.at[i2].get(out_sharding=shard_blocks),
+                sharded_take(Xp, i1, shard_blocks),
+                sharded_take(Xn, i2, shard_blocks),
             )
 
         # the chunk's first blocks (incl. a boundary-aligned t0) are
@@ -206,8 +207,8 @@ def _compiled_trainer(scorer, cfg, mesh, n1, n2):
         r0 = t0 - t0 % cfg.repartition_every
         kr = fold(root, "repartition", r0)
         k1, k2 = jax.random.split(kr)
-        Ab = Xp.at[draw_blocks(k1, n1, m1)].get(out_sharding=shard_blocks)
-        Bb = Xn.at[draw_blocks(k2, n2, m2)].get(out_sharding=shard_blocks)
+        Ab = sharded_take(Xp, draw_blocks(k1, n1, m1), shard_blocks)
+        Bb = sharded_take(Xn, draw_blocks(k2, n2, m2), shard_blocks)
         (params, _, _), losses = lax.scan(
             functools.partial(step_fn, t0=t0, Xp=Xp, Xn=Xn),
             (params, Ab, Bb), t0 + jnp.arange(chunk_len)

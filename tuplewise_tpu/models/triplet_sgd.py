@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tuplewise_tpu.utils.compat import sharded_take
 from tuplewise_tpu.ops.kernels import get_kernel
 from tuplewise_tpu.parallel.mesh import make_mesh
 from tuplewise_tpu.utils.rng import fold, root_key
@@ -153,8 +154,8 @@ def _compiled_triplet_trainer(embedder, cfg, mesh, n1, n2):
             kr = fold(root, "repartition", t)
             k1, k2 = jax.random.split(kr)
             return (
-                Xc.at[draw(k1, n1, m1)].get(out_sharding=shard_blocks),
-                Xo.at[draw(k2, n2, m2)].get(out_sharding=shard_blocks),
+                sharded_take(Xc, draw(k1, n1, m1), shard_blocks),
+                sharded_take(Xo, draw(k2, n2, m2), shard_blocks),
             )
 
         Ab, Bb = lax.cond(
@@ -168,8 +169,8 @@ def _compiled_triplet_trainer(embedder, cfg, mesh, n1, n2):
         r0 = t0 - t0 % cfg.repartition_every
         kr = fold(root, "repartition", r0)
         k1, k2 = jax.random.split(kr)
-        Ab = Xc.at[draw(k1, n1, m1)].get(out_sharding=shard_blocks)
-        Bb = Xo.at[draw(k2, n2, m2)].get(out_sharding=shard_blocks)
+        Ab = sharded_take(Xc, draw(k1, n1, m1), shard_blocks)
+        Bb = sharded_take(Xo, draw(k2, n2, m2), shard_blocks)
         (params, _, _), losses = lax.scan(
             functools.partial(step_fn, t0=t0, Xc=Xc, Xo=Xo),
             (params, Ab, Bb), t0 + jnp.arange(chunk_len)

@@ -1,0 +1,48 @@
+"""L4/L5 serving layer — the batch estimators turned into an online
+service [ISSUE 1].
+
+The batch library answers "given arrays X, Y, what is U_n?". Production
+traffic is a *stream* of scored events, so this package adds:
+
+* ``index.ExactAucIndex``      — incremental exact AUC: sorted base runs
+                                 + a small merge buffer, amortized
+                                 O(log n) insert, periodic jitted
+                                 compaction, optional sliding-window
+                                 eviction. Its estimate after any prefix
+                                 equals the batch ``ops.rank_auc`` /
+                                 NumPy oracle on that prefix.
+* ``streaming.StreamingIncompleteU`` — the paper's incomplete-U knob in
+                                 the online regime: a fixed pair budget
+                                 B per arrival, spent against
+                                 reservoir-held history.
+* ``engine.MicroBatchEngine``  — async request path: bounded queue,
+                                 dynamic batcher coalescing
+                                 insert/score/query requests into
+                                 padded size-bucketed jitted calls,
+                                 flush-on-timeout, explicit
+                                 backpressure (reject / drop-oldest /
+                                 block).
+* ``replay``                   — replay a synthetic stream through the
+                                 engine and report events/s + latency
+                                 percentiles (the ``tuplewise replay``
+                                 CLI and the northstar ``serve`` stage).
+"""
+
+from tuplewise_tpu.serving.engine import (
+    BackpressureError,
+    MicroBatchEngine,
+    ServingConfig,
+)
+from tuplewise_tpu.serving.index import ExactAucIndex
+from tuplewise_tpu.serving.replay import make_stream, replay
+from tuplewise_tpu.serving.streaming import StreamingIncompleteU
+
+__all__ = [
+    "BackpressureError",
+    "ExactAucIndex",
+    "MicroBatchEngine",
+    "ServingConfig",
+    "StreamingIncompleteU",
+    "make_stream",
+    "replay",
+]

@@ -1,0 +1,308 @@
+"""Async micro-batched request path for the streaming estimators.
+
+Request threads submit small insert/score/query requests; a single
+batcher thread drains the bounded queue, coalesces CONSECUTIVE
+same-kind requests (order between kinds is preserved, so a query
+issued after an insert observes it) and dispatches them as one padded
+size-bucketed call — the index's jitted searchsorted/compaction path —
+so per-request Python/dispatch overhead is paid once per micro-batch
+and the hot path stays inside XLA.
+
+Batching policy: the batcher blocks for the first request, then drains
+whatever else arrives within ``flush_timeout_s`` up to ``max_batch``
+(flush-on-timeout). Backpressure is explicit at enqueue time:
+
+  * "reject"      — a full queue fails the submit with
+                    BackpressureError (the caller sees it immediately;
+                    load shedding at the edge).
+  * "drop_oldest" — the oldest queued request is failed with
+                    BackpressureError and the new one admitted
+                    (freshness over completeness).
+  * "block"       — the submitting thread waits for capacity
+                    (backpressure propagates upstream).
+
+Observability: every engine owns a ``MetricsRegistry`` (no process
+globals) with request/batch counters and latency / batch-fill /
+queue-depth histograms; ``stats()`` snapshots everything plus the
+index/streaming state in one JSON-able dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tuplewise_tpu.serving.index import ExactAucIndex
+from tuplewise_tpu.serving.streaming import StreamingIncompleteU
+from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+_KINDS = ("insert", "score", "query")
+
+
+class BackpressureError(RuntimeError):
+    """The request was shed by the engine's backpressure policy."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs of the online service (defaults favor throughput)."""
+
+    kernel: str = "auc"
+    budget: int = 64               # incomplete-U pairs per arrival
+    reservoir: int = 4096          # per-class reservoir capacity
+    design: str = "swr"            # partner sampling design
+    window: Optional[int] = None   # sliding window (arrivals); None = all
+    compact_every: int = 512       # index buffer size triggering compaction
+    engine: str = "jax"            # index count/compaction engine
+    max_batch: int = 256           # micro-batch size cap
+    flush_timeout_s: float = 0.002  # batcher drain window
+    queue_size: int = 1024         # bounded request queue
+    policy: str = "reject"         # reject | drop_oldest | block
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.policy not in ("reject", "drop_oldest", "block"):
+            raise ValueError(f"unknown backpressure policy {self.policy!r}")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1: {self.max_batch}")
+        if self.queue_size < 1:
+            raise ValueError(f"queue_size must be >= 1: {self.queue_size}")
+
+
+class _Request:
+    __slots__ = ("kind", "scores", "labels", "future", "t_enqueue")
+
+    def __init__(self, kind: str, scores, labels):
+        self.kind = kind
+        self.scores = scores
+        self.labels = labels
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class MicroBatchEngine:
+    """Bounded-queue dynamic batcher over the streaming estimators.
+
+    Use as a context manager (or call ``close()``): a worker thread is
+    running between construction and close.
+    """
+
+    def __init__(self, config: Optional[ServingConfig] = None,
+                 **overrides):
+        if config is None:
+            config = ServingConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        self.config = config
+        self.index = ExactAucIndex(
+            window=config.window, compact_every=config.compact_every,
+            engine=config.engine,
+        ) if config.kernel == "auc" else None
+        self.streaming = StreamingIncompleteU(
+            kernel=config.kernel, budget=config.budget,
+            reservoir=config.reservoir, design=config.design,
+            seed=config.seed,
+        )
+        self.metrics = MetricsRegistry()
+        m = self.metrics
+        self._c_req = {k: m.counter(f"requests_{k}_total") for k in _KINDS}
+        self._c_rejected = m.counter("rejected_total")
+        self._c_dropped = m.counter("dropped_total")
+        self._c_batches = m.counter("batches_total")
+        self._c_events = m.counter("events_total")
+        self._c_pairs = m.counter("incomplete_pairs_total")
+        self._h_latency = m.histogram("request_latency_s")
+        self._h_fill = m.histogram(
+            "batch_fill", buckets=[i / 16 for i in range(1, 17)])
+        self._h_depth = m.histogram(
+            "queue_depth", buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256,
+                                    512, 1024, 2048])
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue(
+            maxsize=config.queue_size)
+        self._lock = threading.Lock()   # guards estimator state
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="tuplewise-batcher", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------ #
+    # request side                                                       #
+    # ------------------------------------------------------------------ #
+    def submit(self, kind: str, scores=None, labels=None) -> Future:
+        """Enqueue one request; returns its Future.
+
+        insert: scores + labels (scalars or arrays) — resolves to the
+          number of events inserted.
+        score: scores — resolves to fractional ranks vs negatives.
+        query: no payload — resolves to a state snapshot dict.
+        """
+        if kind not in _KINDS:
+            raise ValueError(f"unknown request kind {kind!r}")
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if kind == "insert":
+            scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
+            labels = np.atleast_1d(np.asarray(labels))
+            if scores.shape != labels.shape:
+                raise ValueError("insert: scores/labels shape mismatch")
+        elif kind == "score":
+            scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
+        req = _Request(kind, scores, labels)
+        self._c_req[kind].inc()
+        policy = self.config.policy
+        if policy == "block":
+            self._q.put(req)
+        else:
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                if policy == "reject":
+                    self._c_rejected.inc()
+                    raise BackpressureError(
+                        f"queue full ({self.config.queue_size}); request "
+                        "rejected") from None
+                # drop_oldest: shed the stalest queued request
+                try:
+                    old = self._q.get_nowait()
+                    if old is not None:
+                        self._c_dropped.inc()
+                        old.future.set_exception(BackpressureError(
+                            "dropped by a newer request (drop_oldest)"))
+                except queue.Empty:
+                    pass
+                self._q.put(req)
+        return req.future
+
+    def insert(self, scores, labels) -> Future:
+        return self.submit("insert", scores, labels)
+
+    def score(self, scores) -> Future:
+        return self.submit("score", scores)
+
+    def query(self) -> Future:
+        return self.submit("query")
+
+    def flush(self, timeout: Optional[float] = 30.0) -> dict:
+        """Barrier: wait until everything enqueued so far is applied."""
+        return self.submit("query").result(timeout=timeout)
+
+    # ------------------------------------------------------------------ #
+    # batcher side                                                       #
+    # ------------------------------------------------------------------ #
+    def _run(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if first is None:       # shutdown sentinel
+                return
+            self._h_depth.observe(self._q.qsize() + 1)
+            batch = [first]
+            deadline = time.perf_counter() + self.config.flush_timeout_s
+            while len(batch) < self.config.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._dispatch(batch)
+                    return
+                batch.append(nxt)
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        self._c_batches.inc()
+        self._h_fill.observe(len(batch) / self.config.max_batch)
+        for kind, run in self._runs(batch):
+            try:
+                if kind == "insert":
+                    self._apply_inserts(run)
+                elif kind == "score":
+                    self._apply_scores(run)
+                else:
+                    snap = self.stats()
+                    for r in run:
+                        r.future.set_result(snap)
+            except Exception as e:      # fail the run, keep serving
+                for r in run:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            now = time.perf_counter()
+            for r in run:
+                self._h_latency.observe(now - r.t_enqueue)
+
+    @staticmethod
+    def _runs(batch: List[_Request]) -> List[Tuple[str, List[_Request]]]:
+        """Split a batch into maximal consecutive same-kind runs —
+        coalescing without reordering across kinds."""
+        runs: List[Tuple[str, List[_Request]]] = []
+        for r in batch:
+            if runs and runs[-1][0] == r.kind:
+                runs[-1][1].append(r)
+            else:
+                runs.append((r.kind, [r]))
+        return runs
+
+    def _apply_inserts(self, run: List[_Request]) -> None:
+        scores = np.concatenate([r.scores for r in run])
+        labels = np.concatenate([r.labels for r in run]).astype(bool)
+        with self._lock:
+            if self.index is not None:
+                self.index.insert_batch(scores, labels)
+            spent = self.streaming.extend(scores, labels)
+        self._c_events.inc(len(scores))
+        self._c_pairs.inc(spent)
+        for r in run:
+            r.future.set_result(len(r.scores))
+
+    def _apply_scores(self, run: List[_Request]) -> None:
+        if self.index is None:
+            raise ValueError(
+                "score requests need the exact AUC index "
+                "(kernel='auc')")
+        scores = np.concatenate([r.scores for r in run])
+        with self._lock:
+            ranks = self.index.score_batch(scores)
+        off = 0
+        for r in run:
+            n = len(r.scores)
+            r.future.set_result(ranks[off:off + n])
+            off += n
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "metrics": self.metrics.snapshot(),
+                "streaming": self.streaming.state(),
+            }
+            if self.index is not None:
+                out["index"] = self.index.state()
+                out["auc_exact"] = self.index.auc()
+            out["estimate_incomplete"] = self.streaming.estimate()
+        return out
+
+    def close(self, timeout: float = 10.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._worker.join(timeout=timeout)
+
+    def __enter__(self) -> "MicroBatchEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

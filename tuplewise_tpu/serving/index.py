@@ -1,0 +1,353 @@
+"""Incremental exact-AUC index — the serving-side twin of ops.rank_auc.
+
+The batch fast path (``ops/rank_auc.py``) sorts once and binary-searches;
+a service cannot re-sort 10^7 scores per arrival. This index keeps the
+Mann-Whitney statistic *incrementally exact* under inserts and
+sliding-window evictions by maintaining the integer pair-win count
+
+    wins2 = sum over current (p, n) pairs of  2*1{p > n} + 1{p = n}
+
+as a Python int (arbitrary precision — exact to any n), so
+
+    AUC = wins2 / (2 * n_pos * n_neg)
+
+matches the batch ``rank_auc`` / NumPy midrank oracle on the same
+multiset to one final float division. Every mutation updates wins2 with
+*counts* (binary searches), never with float accumulation, so the
+estimate is bit-stable across compaction boundaries by construction:
+compaction moves values between containers and never touches wins2.
+
+Per class the container is LSM-shaped:
+
+    base: sorted array  (searchsorted: O(log n))
+    buf:  small unsorted recent-insert buffer (linear scan, bounded)
+    tomb: evicted values still physically inside base (negative counts)
+
+so an insert is O(log n + |buf|) with |buf| bounded by
+``compact_every``; when a buffer fills, a *compaction* merges it into
+the base run with one padded size-bucketed jitted sort (engine="jax")
+or a host merge (engine="numpy"). Counts against base run through a
+bucket-padded jitted searchsorted pair, keeping the steady-state hot
+path inside XLA with O(log n) distinct compiled shapes.
+
+Scores must be finite (the +inf bucket padding relies on it).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+_MIN_BUCKET = 256
+
+
+def _next_bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def _remove_sorted(arr: np.ndarray, values: List[float]) -> np.ndarray:
+    """Remove one occurrence per entry of ``values`` from sorted
+    ``arr`` in a single pass (duplicate values consume consecutive
+    slots). Every value must be present — tombstones reference scores
+    that were inserted."""
+    if not values:
+        return arr
+    idxs = []
+    prev, run = None, 0
+    for t in sorted(values):
+        run = run + 1 if t == prev else 0
+        prev = t
+        i = int(np.searchsorted(arr, t, side="left")) + run
+        assert i < len(arr) and arr[i] == t, "tombstone value not present"
+        idxs.append(i)
+    return np.delete(arr, idxs)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_count_fn(base_bucket: int, q_bucket: int):
+    """(sorted base padded with +inf, queries padded) -> (less, leq)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(base, queries):
+        less = jnp.searchsorted(base, queries, side="left")
+        leq = jnp.searchsorted(base, queries, side="right")
+        return less, leq
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_sort_fn(bucket: int):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda x: jnp.sort(x))
+
+
+class _ClassSide:
+    """One class's LSM container: sorted base + buffer + tombstones."""
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.base = np.empty(0, dtype=dtype)
+        self.buf: List[float] = []
+        self.tomb: List[float] = []
+
+    @property
+    def size(self) -> int:
+        return len(self.base) + len(self.buf) - len(self.tomb)
+
+    def values(self) -> np.ndarray:
+        """Current multiset as an array (oracle/debug path, O(n))."""
+        out = np.concatenate(
+            [self.base, np.asarray(self.buf, dtype=self.dtype)]
+        )
+        out = np.sort(out, kind="stable")
+        return _remove_sorted(out, self.tomb)
+
+
+class ExactAucIndex:
+    """Streaming exact AUC with O(log n) amortized inserts.
+
+    Args:
+      window: retain only the last ``window`` arrivals (across both
+        classes); None = unbounded.
+      compact_every: buffer/tombstone size that triggers a compaction.
+      engine: "jax" — bucket-padded jitted searchsorted + compaction
+        sort (values stored float32, jax's default precision); "numpy" —
+        host searchsorted (values stored float64).
+    """
+
+    def __init__(self, window: Optional[int] = None,
+                 compact_every: int = 512, engine: str = "jax"):
+        if engine not in ("jax", "numpy"):
+            raise ValueError(f"engine must be 'jax' or 'numpy': {engine!r}")
+        if window is not None and window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        if compact_every < 1:
+            raise ValueError(f"compact_every must be >= 1: {compact_every}")
+        self.window = window
+        self.compact_every = compact_every
+        self.engine = engine
+        self.dtype = np.float32 if engine == "jax" else np.float64
+        self._pos = _ClassSide(self.dtype)
+        self._neg = _ClassSide(self.dtype)
+        # arrival order for window eviction: (value, is_pos)
+        self._log: Deque[Tuple[float, bool]] = collections.deque()
+        self._wins2 = 0          # exact: Python int never overflows
+        self.n_compactions = 0
+        self.n_evicted = 0
+
+    # ------------------------------------------------------------------ #
+    # counting primitives (all integer-exact)                            #
+    # ------------------------------------------------------------------ #
+    def _base_counts(self, side: _ClassSide,
+                     q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(less, leq) counts of each query against side.base."""
+        if len(side.base) == 0 or len(q) == 0:
+            z = np.zeros(len(q), dtype=np.int64)
+            return z, z
+        if self.engine == "jax":
+            bb = _next_bucket(len(side.base))
+            qb = _next_bucket(len(q))
+            base_p = np.full(bb, np.inf, dtype=self.dtype)
+            base_p[: len(side.base)] = side.base
+            q_p = np.zeros(qb, dtype=self.dtype)
+            q_p[: len(q)] = q
+            less, leq = _jit_count_fn(bb, qb)(base_p, q_p)
+            return (np.asarray(less)[: len(q)].astype(np.int64),
+                    np.asarray(leq)[: len(q)].astype(np.int64))
+        less = np.searchsorted(side.base, q, side="left")
+        leq = np.searchsorted(side.base, q, side="right")
+        return less.astype(np.int64), leq.astype(np.int64)
+
+    def _counts(self, side: _ClassSide,
+                q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(less, eq) of each query against side's CURRENT multiset."""
+        q = np.asarray(q, dtype=self.dtype)
+        less, leq = self._base_counts(side, q)
+        eq = leq - less
+        for vals, sign in ((side.buf, 1), (side.tomb, -1)):
+            if not vals:
+                continue
+            arr = np.sort(np.asarray(vals, dtype=self.dtype))
+            l2 = np.searchsorted(arr, q, side="left").astype(np.int64)
+            r2 = np.searchsorted(arr, q, side="right").astype(np.int64)
+            less += sign * l2
+            eq += sign * (r2 - l2)
+        return less, eq
+
+    def _cross2(self, p_vals: np.ndarray, n_side: _ClassSide) -> int:
+        """sum over p of 2*count_less(p in negs) + count_eq: the wins2
+        contribution of positives ``p_vals`` against class ``n_side``."""
+        if len(p_vals) == 0 or n_side.size == 0:
+            return 0
+        less, eq = self._counts(n_side, p_vals)
+        return int(2 * less.sum() + eq.sum())
+
+    def _cross2_rev(self, n_vals: np.ndarray, p_side: _ClassSide) -> int:
+        """wins2 contribution of pairs (p in p_side, n in n_vals): the
+        flipped count — per negative, 2*count_pos_greater + count_pos_eq
+        — from the same (less, eq) container searches."""
+        if len(n_vals) == 0 or p_side.size == 0:
+            return 0
+        less, eq = self._counts(p_side, n_vals)
+        greater = p_side.size - less - eq
+        return int(2 * greater.sum() + eq.sum())
+
+    @staticmethod
+    def _cross2_arrays(p: np.ndarray, n: np.ndarray) -> int:
+        """wins2 between two plain arrays (intra-batch pairs)."""
+        if len(p) == 0 or len(n) == 0:
+            return 0
+        ns = np.sort(n)
+        less = np.searchsorted(ns, p, side="left").astype(np.int64)
+        leq = np.searchsorted(ns, p, side="right").astype(np.int64)
+        return int(2 * less.sum() + (leq - less).sum())
+
+    # ------------------------------------------------------------------ #
+    # mutation                                                           #
+    # ------------------------------------------------------------------ #
+    def insert_batch(self, scores, labels) -> int:
+        """Insert arrivals in order; returns the number inserted.
+
+        ``labels`` truthy = positive class. The pair statistic after the
+        call equals the batch statistic over (old set) ∪ (batch) — pair
+        sets are order-free — then window eviction trims to the last
+        ``window`` arrivals.
+        """
+        scores = np.asarray(scores, dtype=self.dtype).ravel()
+        labels = np.asarray(labels).ravel().astype(bool)
+        if scores.shape != labels.shape:
+            raise ValueError(
+                f"scores/labels length mismatch: {scores.shape} vs "
+                f"{labels.shape}")
+        if len(scores) and not np.all(np.isfinite(scores)):
+            raise ValueError("scores must be finite")
+        p_new = scores[labels]
+        n_new = scores[~labels]
+        # new-vs-old (old sets untouched so far), then new-vs-new
+        d = self._cross2(p_new, self._neg)
+        d += self._cross2_rev(n_new, self._pos)
+        d += self._cross2_arrays(p_new, n_new)
+        self._wins2 += d
+        self._pos.buf.extend(p_new.tolist())
+        self._neg.buf.extend(n_new.tolist())
+        for s, is_pos in zip(scores.tolist(), labels.tolist()):
+            self._log.append((s, is_pos))
+        if self.window is not None and len(self._log) > self.window:
+            self._evict(len(self._log) - self.window)
+        self._maybe_compact()
+        return len(scores)
+
+    def _evict(self, count: int) -> None:
+        """Remove the ``count`` oldest arrivals from the statistic."""
+        p_out: List[float] = []
+        n_out: List[float] = []
+        for _ in range(count):
+            v, is_pos = self._log.popleft()
+            (p_out if is_pos else n_out).append(v)
+        p_arr = np.asarray(p_out, dtype=self.dtype)
+        n_arr = np.asarray(n_out, dtype=self.dtype)
+        # pairs with >= 1 evicted endpoint, inclusion-exclusion: the
+        # P_e x N_e block is inside both cross terms (containers still
+        # hold the evicted values here, as the identity requires)
+        d = self._cross2(p_arr, self._neg)
+        d += self._cross2_rev(n_arr, self._pos)
+        d -= self._cross2_arrays(p_arr, n_arr)
+        self._wins2 -= d
+        for side, vals in ((self._pos, p_out), (self._neg, n_out)):
+            for v in vals:
+                try:
+                    side.buf.remove(v)
+                except ValueError:
+                    side.tomb.append(v)
+        self.n_evicted += count
+
+    def _maybe_compact(self) -> None:
+        for side in (self._pos, self._neg):
+            if (len(side.buf) >= self.compact_every
+                    or len(side.tomb) >= self.compact_every):
+                self._compact_side(side)
+
+    def compact(self) -> None:
+        """Force both sides into a single sorted base run."""
+        for side in (self._pos, self._neg):
+            if side.buf or side.tomb:
+                self._compact_side(side)
+
+    def _compact_side(self, side: _ClassSide) -> None:
+        merged = np.concatenate(
+            [side.base, np.asarray(side.buf, dtype=self.dtype)])
+        n = len(merged)
+        if n:
+            if self.engine == "jax":
+                b = _next_bucket(n)
+                padded = np.full(b, np.inf, dtype=self.dtype)
+                padded[:n] = merged
+                merged = np.asarray(_jit_sort_fn(b)(padded))[:n]
+            else:
+                merged = np.sort(merged, kind="stable")
+        side.base = _remove_sorted(merged, side.tomb)
+        side.buf = []
+        side.tomb = []
+        self.n_compactions += 1
+
+    # ------------------------------------------------------------------ #
+    # queries                                                            #
+    # ------------------------------------------------------------------ #
+    @property
+    def n_pos(self) -> int:
+        return self._pos.size
+
+    @property
+    def n_neg(self) -> int:
+        return self._neg.size
+
+    @property
+    def n_events(self) -> int:
+        return len(self._log)
+
+    def auc(self) -> Optional[float]:
+        """Exact AUC of the current window; None until both classes
+        have at least one member."""
+        if self.n_pos == 0 or self.n_neg == 0:
+            return None
+        return self._wins2 / (2.0 * self.n_pos * self.n_neg)
+
+    def score_batch(self, scores) -> np.ndarray:
+        """Fractional rank of each score against current negatives:
+        (count_less + 0.5*count_eq) / n_neg — exactly the per-positive
+        quantity ops.rank_auc averages. NaN when no negatives yet."""
+        q = np.asarray(scores, dtype=self.dtype).ravel()
+        if self.n_neg == 0:
+            return np.full(len(q), np.nan)
+        less, eq = self._counts(self._neg, q)
+        return (less + 0.5 * eq) / float(self.n_neg)
+
+    def oracle_values(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(pos, neg) multisets of the current window — feed these to
+        the batch oracle in parity tests. O(n); not a hot path."""
+        return self._pos.values(), self._neg.values()
+
+    def state(self) -> dict:
+        return {
+            "n_pos": self.n_pos,
+            "n_neg": self.n_neg,
+            "n_events": self.n_events,
+            "auc": self.auc(),
+            "n_compactions": self.n_compactions,
+            "n_evicted": self.n_evicted,
+            "buf_pos": len(self._pos.buf),
+            "buf_neg": len(self._neg.buf),
+            "engine": self.engine,
+            "window": self.window,
+        }

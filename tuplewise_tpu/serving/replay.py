@@ -1,0 +1,131 @@
+"""Replay a scored event stream through the micro-batch engine.
+
+The serving benchmark instrument: generate (or accept) a stream of
+(score, label) events, submit them as individual requests from one or
+more client threads — the engine's dynamic batcher does the coalescing
+— and report sustained events/s, latency percentiles, batch fill,
+backpressure counts, and final-estimate parity against the batch
+oracle. Used by ``tuplewise replay``, ``bench.py --streaming``, and the
+northstar ``serve`` stage.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from tuplewise_tpu.serving.engine import (
+    BackpressureError, MicroBatchEngine, ServingConfig,
+)
+
+
+def make_stream(n_events: int, pos_frac: float = 0.5,
+                separation: float = 1.0, seed: int = 0):
+    """Shuffled Gaussian score stream: positives ~ N(separation, 1),
+    negatives ~ N(0, 1), labels i.i.d. Bernoulli(pos_frac)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.random(n_events) < pos_frac
+    scores = rng.standard_normal(n_events) + separation * labels
+    return scores, labels
+
+
+def replay(scores, labels, config: Optional[ServingConfig] = None,
+           score_every: int = 0, query_every: int = 0,
+           chunk: int = 1, warmup: bool = False, **overrides) -> dict:
+    """Drive the engine with one request per event (or per ``chunk``
+    events) and return the measurement record.
+
+    ``score_every`` / ``query_every``: interleave a score / query
+    request every k events (0 = never) — the mixed-workload case the
+    batcher's kind-run coalescing exists for.
+
+    ``warmup=True`` replays the stream once through a throwaway engine
+    first, so the timed run measures the steady state: the index's
+    size-bucketed jitted shapes compile as the base runs grow through
+    the bucket ladder, and a cold replay pays those one-time XLA
+    compilations inside the timed window (a long-lived service never
+    sees them again).
+    """
+    scores = np.asarray(scores, dtype=np.float64).ravel()
+    labels = np.asarray(labels).ravel().astype(bool)
+    n = len(scores)
+    cfg = config or ServingConfig(**overrides)
+    if warmup:
+        replay(scores, labels, config=cfg, score_every=score_every,
+               query_every=query_every, chunk=chunk, warmup=False)
+    rejected = 0
+    futures = []
+    with MicroBatchEngine(cfg) as eng:
+        t0 = time.perf_counter()
+        for i in range(0, n, chunk):
+            j = min(i + chunk, n)
+            try:
+                futures.append(eng.insert(scores[i:j], labels[i:j]))
+            except BackpressureError:
+                rejected += j - i
+            if score_every and (i // chunk) % score_every == score_every - 1:
+                try:
+                    futures.append(eng.score(scores[i:j]))
+                except BackpressureError:
+                    pass
+            if query_every and (i // chunk) % query_every == query_every - 1:
+                try:
+                    futures.append(eng.query())
+                except BackpressureError:
+                    pass
+        # wait for everything admitted (dropped futures raise)
+        dropped = 0
+        for f in futures:
+            try:
+                f.result(timeout=60.0)
+            except BackpressureError:
+                dropped += 1
+        wall = time.perf_counter() - t0
+        stats = eng.stats()
+
+    lat = stats["metrics"]["request_latency_s"]
+    fill = stats["metrics"]["batch_fill"]
+    applied = stats["metrics"]["events_total"]["value"]
+    rec = {
+        "n_events": n,
+        "events_applied": int(applied),
+        "events_rejected": int(rejected),
+        "requests_dropped": int(dropped),
+        "wall_s": wall,
+        "events_per_s": applied / wall if wall > 0 else None,
+        "latency_p50_ms": None if lat["p50"] is None else lat["p50"] * 1e3,
+        "latency_p99_ms": None if lat["p99"] is None else lat["p99"] * 1e3,
+        "batches": stats["metrics"]["batches_total"]["value"],
+        "mean_batch_fill": fill["mean"],
+        "auc_exact": stats.get("auc_exact"),
+        "estimate_incomplete": stats["estimate_incomplete"],
+        "incomplete_pairs": stats["metrics"]["incomplete_pairs_total"][
+            "value"],
+        "index": stats.get("index"),
+        "config": {
+            "kernel": cfg.kernel, "budget": cfg.budget,
+            "reservoir": cfg.reservoir, "design": cfg.design,
+            "window": cfg.window, "max_batch": cfg.max_batch,
+            "flush_timeout_s": cfg.flush_timeout_s,
+            "queue_size": cfg.queue_size, "policy": cfg.policy,
+            "engine": cfg.engine, "chunk": chunk,
+        },
+    }
+    # oracle parity of the final exact estimate (windowed: oracle over
+    # the retained suffix) — cheap at replay scale, priceless as a
+    # guardrail on every benchmark run
+    if cfg.kernel == "auc" and rejected == 0 and rec["auc_exact"] is not None:
+        w = cfg.window
+        tail_s = scores if w is None else scores[-w:]
+        tail_l = labels if w is None else labels[-w:]
+        from tuplewise_tpu.models.metrics import auc_score
+
+        rec["auc_oracle"] = auc_score(
+            np.asarray(tail_s[tail_l], dtype=np.float32 if cfg.engine ==
+                       "jax" else np.float64),
+            np.asarray(tail_s[~tail_l], dtype=np.float32 if cfg.engine ==
+                       "jax" else np.float64))
+        rec["auc_abs_err"] = abs(rec["auc_exact"] - rec["auc_oracle"])
+    return rec

@@ -1,0 +1,70 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current public API (``jax.shard_map`` with
+``check_vma=``). Older jax releases (< 0.5) ship the same primitive as
+``jax.experimental.shard_map.shard_map`` with the replication check
+spelled ``check_rep=``. ``ensure_shard_map()`` installs a forwarding
+alias so every call site can use the modern spelling unconditionally;
+it is invoked once from the package ``__init__``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def ensure_lax_axis_size() -> None:
+    """Older jax has no ``lax.axis_size``; ``core.axis_frame(name)``
+    returns the same static mesh-axis size there."""
+    try:
+        import jax
+    except ImportError:
+        return
+    if hasattr(jax.lax, "axis_size"):
+        return
+    import jax.core as core
+
+    def _axis_size(axis_name):
+        if isinstance(axis_name, (tuple, list)):
+            n = 1
+            for name in axis_name:
+                n *= core.axis_frame(name)
+            return n
+        return core.axis_frame(axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+
+def sharded_take(x, idx, sharding):
+    """``x[idx]`` with the gather output placed per ``sharding``.
+
+    Newer jax spells this ``x.at[idx].get(out_sharding=...)``; older
+    releases reject the kwarg, where a ``with_sharding_constraint`` on
+    the plain gather pins the same placement.
+    """
+    import jax
+
+    try:
+        return x.at[idx].get(out_sharding=sharding)
+    except TypeError:
+        return jax.lax.with_sharding_constraint(x[idx], sharding)
+
+
+def ensure_shard_map() -> None:
+    try:
+        import jax
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+    except ImportError:
+        # host-only install (numpy/cpp backends) or a jax too old to
+        # have even the experimental module — nothing to shim
+        return
+    if hasattr(jax, "shard_map"):
+        return
+
+    @functools.wraps(_exp_shard_map)
+    def _shard_map_compat(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _exp_shard_map(f, *args, **kwargs)
+
+    jax.shard_map = _shard_map_compat

@@ -1,7 +1,7 @@
 """Tracing / profiling / observability helpers [SURVEY §5.2, §5.6].
 
 The reference has none of this (printed numbers + matplotlib); the build
-standardizes three small tools:
+standardizes a few small tools:
 
 * ``timer()``        — wall-clock context manager; the harness reports
                        its numbers alongside every variance result
@@ -12,13 +12,21 @@ standardizes three small tools:
                        a CLI flag straight through.
 * ``device_memory_stats()`` — per-device HBM usage snapshot where the
                        backend exposes it (TPU does; CPU returns {}).
+* ``Counter`` / ``Histogram`` / ``MetricsRegistry`` — the serving
+                       layer's service metrics (request counts, queue
+                       depth, batch fill, latency percentiles). Plain
+                       thread-safe host objects, no exporter dependency;
+                       ``snapshot()`` renders everything to one JSON-able
+                       dict for the CLI / replay reports.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import threading
 import time
-from typing import Iterator, Optional
+from typing import Dict, Iterator, List, Optional, Sequence
 
 
 @contextlib.contextmanager
@@ -55,6 +63,186 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+# --------------------------------------------------------------------- #
+# service metrics (serving layer)                                        #
+# --------------------------------------------------------------------- #
+
+class Counter:
+    """Monotonic counter: ``c.inc()`` / ``c.inc(5)``; ``c.value``.
+
+    Thread-safe — the micro-batcher increments from its worker thread
+    while request threads read snapshots.
+    """
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, amount: int = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"Counter {self.name}: negative inc {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+
+# Default buckets span the serving latency range: 10 us .. ~100 s.
+_DEFAULT_BUCKETS = tuple(
+    b * s for s in (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
+    for b in (1.0, 2.5, 5.0)
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact-sample percentile estimates.
+
+    Bucket counts give the Prometheus-style cumulative view
+    (``snapshot()``); ``quantile(q)`` interpolates within the retained
+    sample window (last ``max_samples`` observations) so p50/p99 stay
+    exact for short replay runs while memory stays bounded for long
+    services. Thread-safe.
+    """
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Sequence[float]] = None,
+                 max_samples: int = 65536):
+        self.name = name
+        self.help = help
+        self.buckets: List[float] = sorted(buckets or _DEFAULT_BUCKETS)
+        if max_samples < 1:
+            raise ValueError("max_samples must be >= 1")
+        self._max_samples = max_samples
+        self._lock = threading.Lock()
+        self._bucket_counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._samples: List[float] = []   # ring buffer of recent values
+        self._ring_pos = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._bucket_counts[bisect.bisect_left(self.buckets, value)] += 1
+            self._count += 1
+            self._sum += value
+            self._min = value if self._min is None else min(self._min, value)
+            self._max = value if self._max is None else max(self._max, value)
+            if len(self._samples) < self._max_samples:
+                self._samples.append(value)
+            else:
+                self._samples[self._ring_pos] = value
+                self._ring_pos = (self._ring_pos + 1) % self._max_samples
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def mean(self) -> Optional[float]:
+        with self._lock:
+            return self._sum / self._count if self._count else None
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Linear-interpolated quantile over the retained sample window."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        with self._lock:
+            xs = sorted(self._samples)
+        if not xs:
+            return None
+        pos = q * (len(xs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._bucket_counts)
+            count, total = self._count, self._sum
+            vmin, vmax = self._min, self._max
+        out = {
+            "type": "histogram",
+            "count": count,
+            "sum": total,
+            "min": vmin,
+            "max": vmax,
+            "mean": total / count if count else None,
+            "buckets": {
+                ("+inf" if i == len(self.buckets) else repr(self.buckets[i])):
+                    c
+                for i, c in enumerate(counts) if c
+            },
+        }
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            out[label] = self.quantile(q)
+        return out
+
+
+class MetricsRegistry:
+    """Named Counter/Histogram factory + one-call JSON snapshot.
+
+    ``counter(name)`` / ``histogram(name)`` create-or-return, so call
+    sites never coordinate registration order. The serving layer keeps
+    one registry per engine instance (no process-global state to leak
+    between tests).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None,
+                  max_samples: int = 65536) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help, buckets=buckets,
+                              max_samples=max_samples)
+                self._metrics[name] = m
+            elif not isinstance(m, Histogram):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}")
+            return m
+
+    def _get(self, name, cls, help):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}")
+            return m
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in items}
 
 
 def device_memory_stats() -> dict:
