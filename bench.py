@@ -10,8 +10,13 @@ a synthetic stream through the micro-batched request engine
    "vs_baseline": R, ...}
 where vs_baseline is the dynamic batcher's speedup over the same
 engine forced to max_batch=1 (no coalescing) — the quantity the
-micro-batching exists to improve. Latency percentiles and the
-exact-vs-oracle parity check ride along in the same record.
+micro-batching exists to improve. Per-event insert-latency
+p50/p95/p99, the compaction-pause histogram, the exact-vs-oracle
+parity check, and ``p99_insert_vs_sync_compact`` — the p99 win of the
+background compactor over on-thread compaction at the same config
+[ISSUE 2] — ride along in the same record. Submission is a bounded
+closed loop (``--max-inflight``), so percentiles price per-event cost
+rather than queue backlog.
 
 `value` is the complete-AUC pair-kernel throughput of the JAX/TPU tiled
 reduction on one chip (BASELINE.json:2's metric). The reference repo
@@ -26,6 +31,7 @@ like-for-like speedup claim.
 Diagnostics go to stderr; stdout carries exactly the one JSON line.
 """
 
+import dataclasses
 import json
 import sys
 import time
@@ -213,44 +219,75 @@ def _numpy_pairs_per_sec(n=16384, reps=3):
     return (n * n) / dt
 
 
-def _streaming_events_per_sec(n_events=20_000, budget=64, max_batch=256,
-                              window=None, baseline_events=2_000):
-    """Micro-batched serving throughput + unbatched baseline.
+def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
+                              window=None, baseline_events=2_000,
+                              bg_compact=True, max_inflight=64,
+                              flush_timeout_s=0.0005):
+    """Micro-batched serving throughput + unbatched baseline + the
+    on-thread-compaction latency comparison.
 
     Policy "block" so every event is applied (throughput of the full
-    stream, not of the survivors); the baseline measures the same
-    per-event request path with coalescing disabled, on a shorter
-    stream (per-event cost dominates, so the rate is length-stable).
+    stream, not of the survivors). Submission is a bounded closed loop
+    (``max_inflight``): unbounded submission saturates the queue and
+    the latency percentiles measure backlog, not per-event cost — the
+    bound is what lets compaction pauses surface in p99. The unbatched
+    baseline measures the same per-event request path with coalescing
+    disabled, on a shorter stream (per-event cost dominates, so the
+    rate is length-stable). The sync run repeats the main config with
+    ``bg_compact=False`` — the p99 gap is the pause the background
+    compactor removes.
     """
     from tuplewise_tpu.serving import ServingConfig, make_stream, replay
 
     scores, labels = make_stream(n_events, pos_frac=0.5, separation=1.0,
                                  seed=0)
     cfg = ServingConfig(budget=budget, max_batch=max_batch, window=window,
-                        policy="block", flush_timeout_s=0.002)
-    rec = replay(scores, labels, config=cfg, warmup=True)
+                        policy="block", flush_timeout_s=flush_timeout_s,
+                        compact_every=1024, bg_compact=bg_compact)
+    rec = replay(scores, labels, config=cfg, warmup=True,
+                 max_inflight=max_inflight)
     print(
-        f"[bench] streaming n={n_events} batched: "
-        f"{rec['events_per_s']:.0f} ev/s p99={rec['latency_p99_ms']:.1f}ms "
+        f"[bench] streaming n={n_events} batched (bg_compact="
+        f"{bg_compact}): "
+        f"{rec['events_per_s']:.0f} ev/s "
+        f"insert p99={rec['insert_latency_p99_ms']:.1f}ms "
         f"fill={rec['mean_batch_fill']:.2f} "
         f"auc_err={rec.get('auc_abs_err')}", file=sys.stderr,
     )
     nb = min(baseline_events, n_events)
     base_cfg = ServingConfig(budget=budget, max_batch=1, window=window,
-                             policy="block", flush_timeout_s=0.0)
-    base = replay(scores[:nb], labels[:nb], config=base_cfg, warmup=True)
+                             policy="block", flush_timeout_s=0.0,
+                             bg_compact=bg_compact)
+    base = replay(scores[:nb], labels[:nb], config=base_cfg, warmup=True,
+                  max_inflight=max_inflight)
     print(
         f"[bench] streaming baseline (max_batch=1, n={nb}): "
         f"{base['events_per_s']:.0f} ev/s", file=sys.stderr,
     )
-    return rec, base
+    sync = None
+    if bg_compact:
+        sync = replay(scores, labels,
+                      config=dataclasses.replace(cfg, bg_compact=False),
+                      warmup=True, max_inflight=max_inflight)
+        pause = sync["compaction_pause_p99_ms"]   # None below 1 compaction
+        print(
+            f"[bench] streaming sync-compaction comparison: "
+            f"{sync['events_per_s']:.0f} ev/s "
+            f"insert p99={sync['insert_latency_p99_ms']:.1f}ms "
+            f"pause p99="
+            + (f"{pause:.1f}ms" if pause is not None else "n/a"),
+            file=sys.stderr,
+        )
+    return rec, base, sync
 
 
 def _streaming_main(args):
-    rec, base = _streaming_events_per_sec(
+    rec, base, sync = _streaming_events_per_sec(
         n_events=args.n_events, budget=args.budget,
         max_batch=args.max_batch, window=args.window,
         baseline_events=args.baseline_events,
+        bg_compact=not args.sync_compact,
+        max_inflight=args.max_inflight,
     )
     out = {
         "metric": "events/sec",
@@ -263,10 +300,29 @@ def _streaming_main(args):
         ),
         "latency_p50_ms": rec["latency_p50_ms"],
         "latency_p99_ms": rec["latency_p99_ms"],
+        "insert_latency_p50_ms": rec["insert_latency_p50_ms"],
+        "insert_latency_p95_ms": rec["insert_latency_p95_ms"],
+        "insert_latency_p99_ms": rec["insert_latency_p99_ms"],
+        "compactions": rec["compactions"],
+        "compaction_pause_p99_ms": rec["compaction_pause_p99_ms"],
+        "bg_compact": not args.sync_compact,
+        "max_inflight": args.max_inflight,
         "mean_batch_fill": rec["mean_batch_fill"],
         "auc_abs_err": rec.get("auc_abs_err"),
         "n_events": rec["n_events"],
     }
+    if sync is not None:
+        out["sync_compact_insert_p99_ms"] = sync["insert_latency_p99_ms"]
+        out["sync_compact_pause_p99_ms"] = sync["compaction_pause_p99_ms"]
+        if rec["insert_latency_p99_ms"]:
+            out["p99_insert_vs_sync_compact"] = round(
+                sync["insert_latency_p99_ms"]
+                / rec["insert_latency_p99_ms"], 2)
+        out["p99_note"] = (
+            "p99_insert_vs_sync_compact: same config with compaction "
+            "forced back onto the batcher thread — the pause the "
+            "background compactor removes from the request path"
+        )
     print(json.dumps(out))
 
 
@@ -277,11 +333,18 @@ def main():
     ap.add_argument("--streaming", action="store_true",
                     help="benchmark the micro-batched serving path "
                          "instead of the batch pair kernel")
-    ap.add_argument("--n-events", type=int, default=20_000)
+    ap.add_argument("--n-events", type=int, default=300_000)
     ap.add_argument("--budget", type=int, default=64)
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--window", type=int, default=None)
     ap.add_argument("--baseline-events", type=int, default=2_000)
+    ap.add_argument("--max-inflight", type=int, default=64,
+                    help="bound outstanding requests (closed-loop load;"
+                         " percentiles measure per-event cost, not"
+                         " backlog)")
+    ap.add_argument("--sync-compact", action="store_true",
+                    help="compact on the batcher thread (pre-PR2 "
+                         "behavior); skips the sync comparison run")
     args = ap.parse_args()
     if args.streaming:
         _streaming_main(args)

@@ -15,4 +15,12 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
-exit $rc
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Streaming latency smoke [ISSUE 2]: replay a small stream through the
+# serving engine (background compaction on), assert the insert-latency
+# percentile fields are present and the exact index matches the batch
+# oracle; writes results/serving_smoke.jsonl for the CI artifact.
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/streaming_smoke.py
+exit $?

@@ -427,31 +427,64 @@ def main():
         # exact-vs-oracle parity guardrail, to results/serving.jsonl.
         # Budget sweep exposes the ONLINE variance-vs-budget knob;
         # max_batch 1 row prices the coalescing the engine exists for.
+        import jax
+
         from tuplewise_tpu.serving import ServingConfig
         from tuplewise_tpu.serving.replay import make_stream, replay
 
-        nS = 2_000 if q else 100_000
+        nS = 2_000 if q else 300_000
         log(f"== stage serve (replay, n_events={nS}) ==")
         sS, lS = make_stream(nS, pos_frac=0.5, separation=1.0, seed=0)
         path = _out("serving.jsonl")
         if os.path.exists(path):
             os.remove(path)
+        # submission is a bounded closed loop (max_inflight): latency
+        # percentiles then price per-event cost + pause spikes, not
+        # queue backlog — the regime the bg-compaction p99 win [ISSUE 2]
+        # is defined in. The sync-compaction cell is the on-thread
+        # baseline that win is measured against.
         cells = [
-            {"max_batch": 256, "budget": 64},
-            {"max_batch": 256, "budget": 4},
-            {"max_batch": 256, "budget": 64, "window": nS // 4},
+            {"max_batch": 256, "budget": 64},        # sync compaction
+            {"max_batch": 256, "budget": 64, "bg_compact": True},
+            {"max_batch": 256, "budget": 4, "bg_compact": True},
+            {"max_batch": 256, "budget": 64, "window": nS // 4,
+             "bg_compact": True},
             {"max_batch": 1, "budget": 64},          # unbatched baseline
         ]
+        if jax.device_count() >= 4:
+            # mesh-sharded index (per-shard searchsorted + psum'd win
+            # counts) — needs >= 4 devices (TPU pod slice, or the
+            # 8-virtual-device CPU test config)
+            cells.insert(2, {"max_batch": 256, "budget": 64,
+                             "bg_compact": True, "mesh_shards": 4})
+        p99s = {}
         for cell in cells:
-            cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
-                                **cell)
-            rec = replay(sS, lS, config=cfg, warmup=not q)
+            # low-latency regime (small flush window, 64 in flight):
+            # the percentiles price per-event cost + pause spikes
+            cfg = ServingConfig(policy="block", flush_timeout_s=0.0005,
+                                compact_every=1024, **cell)
+            # the unbatched baseline prices COALESCING (its rate is
+            # length-stable); a shorter stream bounds its wall time
+            nCell = min(nS, 50_000) if cell.get("max_batch") == 1 else nS
+            rec = replay(sS[:nCell], lS[:nCell], config=cfg, warmup=not q,
+                         max_inflight=64)
             rec["stage"] = "serve"
+            rec["max_inflight"] = 64
             write_jsonl([rec], path)
+            if cell.get("max_batch") != 1 and "window" not in cell \
+                    and cell.get("budget") == 64 \
+                    and "mesh_shards" not in cell:
+                p99s[bool(cell.get("bg_compact"))] = \
+                    rec["insert_latency_p99_ms"]
             log(f"serve {cell}: {rec['events_per_s']:.0f} ev/s "
-                f"p99={rec['latency_p99_ms']:.1f}ms "
+                f"insert p99={rec['insert_latency_p99_ms']:.1f}ms "
+                f"pause p99={rec['compaction_pause_p99_ms']} "
                 f"fill={rec['mean_batch_fill']:.2f} "
                 f"auc_err={rec.get('auc_abs_err')}")
+        if True in p99s and False in p99s and p99s[True]:
+            log(f"serve: bg-compaction p99 insert win = "
+                f"{p99s[False] / p99s[True]:.1f}x "
+                f"(sync {p99s[False]:.1f}ms -> bg {p99s[True]:.1f}ms)")
 
     if "figs" in stages:
         log("== stage figures ==")

@@ -1,0 +1,82 @@
+"""CI streaming-latency smoke [ISSUE 2 satellite].
+
+A fast end-to-end check of the serving path as CI sees it: replay a
+small stream through the micro-batch engine with background compaction
+on, assert the latency-percentile fields are present and the exact
+estimate matches the batch oracle, and append the row (stage
+"ci_smoke") to a serving JSONL the workflow uploads as an artifact.
+
+Usage: python scripts/streaming_smoke.py [--n-events 4000]
+                                         [--out results/serving_smoke.jsonl]
+Exits nonzero on any missing field or parity breach.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REQUIRED_FIELDS = (
+    "events_per_s",
+    "insert_latency_p50_ms",
+    "insert_latency_p95_ms",
+    "insert_latency_p99_ms",
+    "compaction_pause_p99_ms",
+    "compactions",
+    "auc_abs_err",
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-events", type=int, default=4_000)
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "serving_smoke.jsonl"))
+    args = ap.parse_args(argv)
+
+    from tuplewise_tpu.serving import ServingConfig
+    from tuplewise_tpu.serving.replay import make_stream, replay
+
+    scores, labels = make_stream(args.n_events, pos_frac=0.5,
+                                 separation=1.0, seed=0)
+    cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
+                        compact_every=256, bg_compact=True)
+    rec = replay(scores, labels, config=cfg, max_inflight=256)
+    rec["stage"] = "ci_smoke"
+
+    failures = [f for f in REQUIRED_FIELDS if rec.get(f) is None]
+    if failures:
+        print(f"SMOKE FAIL: missing/None fields {failures}",
+              file=sys.stderr)
+        return 1
+    if rec["compactions"] < 1:
+        print("SMOKE FAIL: stream never crossed a compaction",
+              file=sys.stderr)
+        return 1
+    # exact-index parity vs the batch oracle: the guardrail the whole
+    # index design exists for — a streaming-vs-batch mismatch fails CI
+    if rec["auc_abs_err"] > 1e-6:
+        print(f"SMOKE FAIL: auc_abs_err={rec['auc_abs_err']}",
+              file=sys.stderr)
+        return 1
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(
+        f"streaming smoke OK: {rec['events_per_s']:.0f} ev/s, insert "
+        f"p99={rec['insert_latency_p99_ms']:.2f}ms, "
+        f"{rec['compactions']} compactions, "
+        f"auc_abs_err={rec['auc_abs_err']:.1e} -> {args.out}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,3 +34,11 @@ def test_streaming_bench_emits_one_json_line():
     assert rec["vs_baseline"] > 0
     # parity guardrail rides in the same record
     assert rec["auc_abs_err"] < 1e-6
+    # per-event insert-latency percentiles + the sync-compaction
+    # comparison [ISSUE 2 satellite]
+    for key in ("insert_latency_p50_ms", "insert_latency_p95_ms",
+                "insert_latency_p99_ms", "sync_compact_insert_p99_ms",
+                "p99_insert_vs_sync_compact"):
+        assert key in rec, f"missing {key!r} in {rec}"
+    assert rec["insert_latency_p99_ms"] > 0
+    assert rec["bg_compact"] is True

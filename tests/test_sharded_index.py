@@ -1,0 +1,111 @@
+"""Mesh-sharded serving index [ISSUE 2 tentpole].
+
+The contract: sharding the base runs over an S-device mesh (per-shard
+jitted searchsorted + psum'd integer win counts) changes WHERE counts
+are computed, never their values — wins2, every prefix AUC, and every
+fractional rank are bit-identical to the single-host index (and match
+the NumPy midrank oracle) at mesh sizes 1, 2, and 4, on the 8
+virtual-CPU-device test platform.
+"""
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.models.metrics import auc_score
+from tuplewise_tpu.serving import ExactAucIndex, MicroBatchEngine
+from tuplewise_tpu.serving.replay import make_stream
+
+
+def _stream(n, seed=7, pos_frac=0.45):
+    scores, labels = make_stream(n, pos_frac=pos_frac, separation=1.0,
+                                 seed=seed)
+    return scores.astype(np.float32), labels
+
+
+def _oracle(scores, labels):
+    pos, neg = scores[labels], scores[~labels]
+    if len(pos) == 0 or len(neg) == 0:
+        return None
+    return auc_score(pos.astype(np.float64), neg.astype(np.float64))
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4])
+class TestShardedBitParity:
+    def test_prefix_wins2_bit_identical_to_single_host(self, shards):
+        scores, labels = _stream(1500)
+        sharded = ExactAucIndex(engine="jax", compact_every=96,
+                                shards=shards)
+        single = ExactAucIndex(engine="jax", compact_every=96)
+        off = 0
+        for c in (1, 2, 50, 96, 97, 200, 513, 777, 1024, 1500):
+            sharded.insert_batch(scores[off:c], labels[off:c])
+            single.insert_batch(scores[off:c], labels[off:c])
+            off = c
+            # INTEGER state equality — stronger than float tolerance
+            assert sharded._wins2 == single._wins2, c
+            assert sharded.auc() == single.auc(), c
+            oracle = _oracle(scores[:c], labels[:c])
+            if oracle is not None:
+                assert sharded.auc() == pytest.approx(oracle, abs=1e-6)
+        assert sharded.n_compactions > 0
+
+    def test_windowed_eviction_parity(self, shards):
+        scores, labels = _stream(1200, seed=5)
+        W = 300
+        sharded = ExactAucIndex(engine="jax", window=W, compact_every=48,
+                                shards=shards)
+        single = ExactAucIndex(engine="jax", window=W, compact_every=48)
+        for i in range(0, 1200, 29):
+            k = min(i + 29, 1200)
+            sharded.insert_batch(scores[i:k], labels[i:k])
+            single.insert_batch(scores[i:k], labels[i:k])
+            assert sharded._wins2 == single._wins2, k
+            assert sharded.auc() == single.auc(), k
+        tail_s, tail_l = scores[-W:], labels[-W:]
+        assert sharded.auc() == pytest.approx(_oracle(tail_s, tail_l),
+                                              abs=1e-6)
+
+    def test_score_batch_bit_identical(self, shards):
+        scores, labels = _stream(900, seed=3)
+        sharded = ExactAucIndex(engine="jax", compact_every=64,
+                                shards=shards)
+        single = ExactAucIndex(engine="jax", compact_every=64)
+        sharded.insert_batch(scores, labels)
+        single.insert_batch(scores, labels)
+        q = np.linspace(-3, 3, 37, dtype=np.float32)
+        np.testing.assert_array_equal(sharded.score_batch(q),
+                                      single.score_batch(q))
+
+
+class TestShardedConfig:
+    def test_rejects_numpy_engine(self):
+        with pytest.raises(ValueError, match="engine='jax'"):
+            ExactAucIndex(engine="numpy", shards=2)
+
+    def test_existing_mesh_accepted(self):
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        idx = ExactAucIndex(engine="jax", mesh=make_mesh(2),
+                            compact_every=32)
+        scores, labels = _stream(200, seed=9)
+        idx.insert_batch(scores, labels)
+        assert idx.shards == 2
+        assert idx.auc() == pytest.approx(_oracle(scores, labels),
+                                          abs=1e-6)
+
+    def test_state_reports_shards(self):
+        idx = ExactAucIndex(engine="jax", shards=2)
+        assert idx.state()["shards"] == 2
+        assert ExactAucIndex(engine="jax").state()["shards"] is None
+
+
+class TestEngineIntegration:
+    def test_mesh_shards_through_the_engine(self):
+        scores, labels = _stream(800, seed=13)
+        with MicroBatchEngine(mesh_shards=2, compact_every=64,
+                              policy="block") as eng:
+            eng.insert(scores, labels).result(30.0)
+            snap = eng.flush()
+        assert snap["index"]["shards"] == 2
+        assert snap["auc_exact"] == pytest.approx(
+            _oracle(scores, labels), abs=1e-6)

@@ -45,6 +45,13 @@ from tuplewise_tpu.parallel.partition import pack_all
 from tuplewise_tpu.utils.rng import fold, root_key
 
 
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """[S, ...] one-row-per-worker placement over every mesh axis — the
+    block layout shared by the ring estimators and the serving index's
+    sharded base runs (parallel.sharded_counts)."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+
 @register_backend("mesh")
 class MeshBackend:
     """SPMD execution over a 1-D device mesh (one worker per chip)."""
@@ -95,7 +102,7 @@ class MeshBackend:
             raise ValueError(f"mesh must be 1-D or 2-D, got axes {axes}")
         PA = P(axes)  # shard axis 0 over every mesh axis
 
-        shard2 = NamedSharding(self.mesh, PA)             # [N, ...] blocks
+        shard2 = row_sharding(self.mesh)                  # [N, ...] blocks
         self._block_sharding = shard2
 
         # ---- complete: ring over the mesh ----------------------------- #

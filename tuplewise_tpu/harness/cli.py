@@ -112,7 +112,24 @@ def _serve_stdin(cfg) -> int:
                 resp = {"ok": False, "error": f"bad request: {e}"}
             print(json.dumps(resp), flush=True)
         stats = eng.stats()
-    print(json.dumps({"final_stats": stats["metrics"]}), file=sys.stderr)
+    m = stats["metrics"]
+
+    def _p(name, q):
+        v = m.get(name, {}).get(q)
+        return None if v is None else round(v * 1e3, 3)
+
+    # exit summary: the load-shedding and pause numbers an operator
+    # grep for first, ahead of the full metrics dump
+    summary = {
+        "rejected_total": m.get("rejected_total", {}).get("value", 0),
+        "dropped_total": m.get("dropped_total", {}).get("value", 0),
+        "compactions_total": m.get("compactions_total", {}).get("value", 0),
+        "compaction_pause_p99_ms": _p("compaction_pause_s", "p99"),
+        "compaction_pause_max_ms": _p("compaction_pause_s", "max"),
+        "insert_latency_p99_ms": _p("insert_latency_s", "p99"),
+    }
+    print(json.dumps({"exit_summary": summary}), file=sys.stderr)
+    print(json.dumps({"final_stats": m}), file=sys.stderr)
     return 0
 
 
@@ -198,6 +215,14 @@ def main(argv=None) -> int:
         p.add_argument("--compact-every", type=int, default=512)
         p.add_argument("--engine", default="jax", choices=["jax", "numpy"],
                        help="exact-index count/compaction engine")
+        p.add_argument("--mesh-shards", type=int, default=None,
+                       help="shard the exact index's base runs over an "
+                            "N-device mesh (per-shard searchsorted + "
+                            "psum'd win counts); default single-host")
+        p.add_argument("--bg-compact", action="store_true",
+                       help="compact the exact index on a side thread "
+                            "(double-buffered base run; no sort pause "
+                            "on the request path)")
         p.add_argument("--max-batch", type=int, default=256)
         p.add_argument("--flush-timeout-ms", type=float, default=2.0)
         p.add_argument("--queue-size", type=int, default=1024)
@@ -237,7 +262,8 @@ def main(argv=None) -> int:
             kernel=args.kernel, budget=args.budget,
             reservoir=args.reservoir, design=args.design,
             window=args.window, compact_every=args.compact_every,
-            engine=args.engine, max_batch=args.max_batch,
+            engine=args.engine, mesh_shards=args.mesh_shards,
+            bg_compact=args.bg_compact, max_batch=args.max_batch,
             flush_timeout_s=args.flush_timeout_ms / 1e3,
             queue_size=args.queue_size, policy=args.policy,
             seed=args.seed,

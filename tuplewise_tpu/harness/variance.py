@@ -426,8 +426,19 @@ def run_variance_experiment(
                     config=cfg.to_json(),
                 )
     estimates = np.concatenate(est_parts) if est_parts else np.empty(0)
+    try:
+        import jax
+
+        # jax.random draws are PLATFORM-dependent (f32 normal synthesis
+        # differs TPU vs CPU), so fix_data rows can only be regenerated
+        # bit-identically on a matching host; the results audit
+        # (scripts/stat_check.py) keys off this stamp
+        platform = jax.default_backend()
+    except Exception:
+        platform = "host"
     result = {
         "config": cfg.to_json(),
+        "platform": platform,
         "mean": float(np.mean(estimates)),
         "variance": float(np.var(estimates, ddof=1)),
         "std_error": float(np.std(estimates, ddof=1) / np.sqrt(cfg.n_reps)),

@@ -10,7 +10,14 @@ traffic is a *stream* of scored events, so this package adds:
                                  compaction, optional sliding-window
                                  eviction. Its estimate after any prefix
                                  equals the batch ``ops.rank_auc`` /
-                                 NumPy oracle on that prefix.
+                                 NumPy oracle on that prefix. Base runs
+                                 shard over a device mesh (``shards=S``:
+                                 per-shard searchsorted + psum'd integer
+                                 win counts, bit-identical at every S)
+                                 and compaction can run on a side thread
+                                 (``bg_compact=True``: double-buffered
+                                 base run, atomic swap — no sort pause
+                                 on the request path).
 * ``streaming.StreamingIncompleteU`` — the paper's incomplete-U knob in
                                  the online regime: a fixed pair budget
                                  B per arrival, spent against

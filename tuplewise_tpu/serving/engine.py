@@ -60,6 +60,8 @@ class ServingConfig:
     window: Optional[int] = None   # sliding window (arrivals); None = all
     compact_every: int = 512       # index buffer size triggering compaction
     engine: str = "jax"            # index count/compaction engine
+    mesh_shards: Optional[int] = None  # shard index base runs over a mesh
+    bg_compact: bool = False       # compact on a side thread (no sort pause)
     max_batch: int = 256           # micro-batch size cap
     flush_timeout_s: float = 0.002  # batcher drain window
     queue_size: int = 1024         # bounded request queue
@@ -100,16 +102,19 @@ class MicroBatchEngine:
         elif overrides:
             config = dataclasses.replace(config, **overrides)
         self.config = config
+        self.metrics = MetricsRegistry()
+        # the index records compactions_total / compaction_pause_s into
+        # the engine's registry, so stats() carries the pause histogram
         self.index = ExactAucIndex(
             window=config.window, compact_every=config.compact_every,
-            engine=config.engine,
+            engine=config.engine, shards=config.mesh_shards,
+            bg_compact=config.bg_compact, metrics=self.metrics,
         ) if config.kernel == "auc" else None
         self.streaming = StreamingIncompleteU(
             kernel=config.kernel, budget=config.budget,
             reservoir=config.reservoir, design=config.design,
             seed=config.seed,
         )
-        self.metrics = MetricsRegistry()
         m = self.metrics
         self._c_req = {k: m.counter(f"requests_{k}_total") for k in _KINDS}
         self._c_rejected = m.counter("rejected_total")
@@ -118,6 +123,9 @@ class MicroBatchEngine:
         self._c_events = m.counter("events_total")
         self._c_pairs = m.counter("incomplete_pairs_total")
         self._h_latency = m.histogram("request_latency_s")
+        # per-event insert latency (enqueue -> applied), the number the
+        # compaction-pause work is judged by in bench.py --streaming
+        self._h_insert_lat = m.histogram("insert_latency_s")
         self._h_fill = m.histogram(
             "batch_fill", buckets=[i / 16 for i in range(1, 17)])
         self._h_depth = m.histogram(
@@ -242,6 +250,8 @@ class MicroBatchEngine:
             now = time.perf_counter()
             for r in run:
                 self._h_latency.observe(now - r.t_enqueue)
+                if kind == "insert":
+                    self._h_insert_lat.observe(now - r.t_enqueue)
 
     @staticmethod
     def _runs(batch: List[_Request]) -> List[Tuple[str, List[_Request]]]:
@@ -300,6 +310,8 @@ class MicroBatchEngine:
         self._closed = True
         self._q.put(None)
         self._worker.join(timeout=timeout)
+        if self.index is not None:
+            self.index.close(timeout=timeout)
 
     def __enter__(self) -> "MicroBatchEngine":
         return self

@@ -25,10 +25,36 @@ Per class the container is LSM-shaped:
 
 so an insert is O(log n + |buf|) with |buf| bounded by
 ``compact_every``; when a buffer fills, a *compaction* merges it into
-the base run with one padded size-bucketed jitted sort (engine="jax")
-or a host merge (engine="numpy"). Counts against base run through a
-bucket-padded jitted searchsorted pair, keeping the steady-state hot
-path inside XLA with O(log n) distinct compiled shapes.
+the base run. Counts against base run through a bucket-padded jitted
+searchsorted pair, keeping the steady-state hot path inside XLA with
+O(log n) distinct compiled shapes.
+
+**Sharded base runs** (``shards=S``): the sorted base is split into S
+contiguous slices, one per device of a 1-D mesh (``parallel.mesh`` +
+the mesh backend's row placement); each count query runs a per-shard
+jitted ``searchsorted`` and psums the integer counts over the mesh
+(``parallel.sharded_counts``). Counting is additive over any multiset
+partition and integer sums are exact, so the sharded counts — and
+therefore wins2 and every AUC — are bit-identical to the single-host
+index at every mesh size. The online path scales like the batch ring:
+per-shard log-time work plus one reduction.
+
+**Background compaction** (``bg_compact=True``): the merge sort moves
+to a side thread with a double-buffered base run. On trigger, the
+compactor snapshots (base, buf prefix, tomb prefix) under the lock,
+builds the merged run off-lock (the buffer keeps absorbing inserts),
+then atomically swaps the new base in and trims the consumed prefixes.
+The insert path never blocks on a sort again — its worst pause is the
+O(1) pointer swap, recorded in the ``compaction_pause_s`` histogram
+(which, in synchronous mode, records full merge durations instead: the
+two modes are directly comparable in ``bench.py --streaming``).
+Evictions racing a build only remove physical copies from the
+*unsnapshotted* buffer suffix; anything else becomes a tombstone
+applied at the NEXT build, so the snapshot the compactor merges is
+immutable. wins2 is always updated synchronously on the caller's
+thread — compaction (foreground or background) never touches it, so
+prefix AUCs are bit-identical to the synchronous index under any
+interleaving.
 
 Scores must be finite (the +inf bucket padding relies on it).
 """
@@ -37,6 +63,9 @@ from __future__ import annotations
 
 import collections
 import functools
+import queue
+import threading
+import time
 from typing import Deque, List, Optional, Tuple
 
 import numpy as np
@@ -93,17 +122,33 @@ def _jit_sort_fn(bucket: int):
 
 
 class _ClassSide:
-    """One class's LSM container: sorted base + buffer + tombstones."""
+    """One class's LSM container: sorted base + buffer + tombstones.
+
+    ``snap_buf``/``snap_tomb`` mark the prefix lengths an in-flight
+    background build has snapshotted (0 when idle): mutators must treat
+    those prefixes as immutable, and the swap trims exactly them.
+    """
 
     def __init__(self, dtype):
         self.dtype = dtype
         self.base = np.empty(0, dtype=dtype)
         self.buf: List[float] = []
         self.tomb: List[float] = []
+        self.base_dev = None     # [S, cap] device shards (sharded mode)
+        self.cap = 0
+        self.building = False
+        self.snap_buf = 0
+        self.snap_tomb = 0
 
     @property
     def size(self) -> int:
         return len(self.base) + len(self.buf) - len(self.tomb)
+
+    @property
+    def pending(self) -> Tuple[int, int]:
+        """(buf, tomb) entries NOT already claimed by an in-flight
+        build — what a new compaction would consume."""
+        return len(self.buf) - self.snap_buf, len(self.tomb) - self.snap_tomb
 
     def values(self) -> np.ndarray:
         """Current multiset as an array (oracle/debug path, O(n))."""
@@ -124,20 +169,48 @@ class ExactAucIndex:
       engine: "jax" — bucket-padded jitted searchsorted + compaction
         sort (values stored float32, jax's default precision); "numpy" —
         host searchsorted (values stored float64).
+      shards: None (default) = single-host base runs. An int S >= 1
+        shards the base runs over an S-device mesh (engine="jax" only);
+        S=1 exercises the mesh path on one device. Counts stay
+        bit-identical to the single-host index at every S.
+      mesh: an existing ``jax.sharding.Mesh`` to shard over (overrides
+        ``shards``); must be 1-D.
+      bg_compact: move compaction merges to a side thread with a
+        double-buffered base run and an atomic swap; the insert path
+        never blocks on a sort.
+      metrics: a ``utils.profiling.MetricsRegistry`` to record
+        ``compactions_total`` / ``compaction_pause_s`` into (the engine
+        passes its own so pauses surface in ``stats()``); None = a
+        private registry.
     """
 
     def __init__(self, window: Optional[int] = None,
-                 compact_every: int = 512, engine: str = "jax"):
+                 compact_every: int = 512, engine: str = "jax",
+                 shards: Optional[int] = None, mesh=None,
+                 bg_compact: bool = False, metrics=None):
         if engine not in ("jax", "numpy"):
             raise ValueError(f"engine must be 'jax' or 'numpy': {engine!r}")
         if window is not None and window < 2:
             raise ValueError(f"window must be >= 2, got {window}")
         if compact_every < 1:
             raise ValueError(f"compact_every must be >= 1: {compact_every}")
+        if mesh is not None:
+            shards = int(np.prod(mesh.devices.shape))
+        if shards is not None and shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        if shards is not None and engine != "jax":
+            raise ValueError("sharded base runs need engine='jax'")
         self.window = window
         self.compact_every = compact_every
         self.engine = engine
+        self.shards = shards
+        self.bg_compact = bg_compact
         self.dtype = np.float32 if engine == "jax" else np.float64
+        self._mesh = mesh
+        if shards is not None and mesh is None:
+            from tuplewise_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(shards)
         self._pos = _ClassSide(self.dtype)
         self._neg = _ClassSide(self.dtype)
         # arrival order for window eviction: (value, is_pos)
@@ -145,6 +218,24 @@ class ExactAucIndex:
         self._wins2 = 0          # exact: Python int never overflows
         self.n_compactions = 0
         self.n_evicted = 0
+        from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._c_compactions = self.metrics.counter("compactions_total")
+        self._h_pause = self.metrics.histogram("compaction_pause_s")
+        # one re-entrant lock guards ALL container structure; the
+        # condition signals build completion (compact() drains on it).
+        # Synchronous mode takes the same (uncontended) lock — one code
+        # path, negligible cost.
+        self._cv = threading.Condition(threading.RLock())
+        self._closed = False
+        self._bg_test_hook = None    # tests: called at build start
+        if bg_compact:
+            self._jobs: "queue.Queue[Optional[_ClassSide]]" = queue.Queue()
+            self._compactor = threading.Thread(
+                target=self._compact_worker, name="tuplewise-compactor",
+                daemon=True)
+            self._compactor.start()
 
     # ------------------------------------------------------------------ #
     # counting primitives (all integer-exact)                            #
@@ -155,6 +246,11 @@ class ExactAucIndex:
         if len(side.base) == 0 or len(q) == 0:
             z = np.zeros(len(q), dtype=np.int64)
             return z, z
+        if self.shards is not None:
+            from tuplewise_tpu.parallel.sharded_counts import sharded_counts
+
+            return sharded_counts(
+                self._mesh, side.base_dev, side.cap, q, self.dtype)
         if self.engine == "jax":
             bb = _next_bucket(len(side.base))
             qb = _next_bucket(len(q))
@@ -234,18 +330,19 @@ class ExactAucIndex:
             raise ValueError("scores must be finite")
         p_new = scores[labels]
         n_new = scores[~labels]
-        # new-vs-old (old sets untouched so far), then new-vs-new
-        d = self._cross2(p_new, self._neg)
-        d += self._cross2_rev(n_new, self._pos)
-        d += self._cross2_arrays(p_new, n_new)
-        self._wins2 += d
-        self._pos.buf.extend(p_new.tolist())
-        self._neg.buf.extend(n_new.tolist())
-        for s, is_pos in zip(scores.tolist(), labels.tolist()):
-            self._log.append((s, is_pos))
-        if self.window is not None and len(self._log) > self.window:
-            self._evict(len(self._log) - self.window)
-        self._maybe_compact()
+        with self._cv:
+            # new-vs-old (old sets untouched so far), then new-vs-new
+            d = self._cross2(p_new, self._neg)
+            d += self._cross2_rev(n_new, self._pos)
+            d += self._cross2_arrays(p_new, n_new)
+            self._wins2 += d
+            self._pos.buf.extend(p_new.tolist())
+            self._neg.buf.extend(n_new.tolist())
+            for s, is_pos in zip(scores.tolist(), labels.tolist()):
+                self._log.append((s, is_pos))
+            if self.window is not None and len(self._log) > self.window:
+                self._evict(len(self._log) - self.window)
+            self._maybe_compact()
         return len(scores)
 
     def _evict(self, count: int) -> None:
@@ -267,87 +364,225 @@ class ExactAucIndex:
         for side, vals in ((self._pos, p_out), (self._neg, n_out)):
             for v in vals:
                 try:
-                    side.buf.remove(v)
+                    # only the UNSNAPSHOTTED suffix is removable in
+                    # place: an in-flight build owns buf[:snap_buf] and
+                    # will merge those copies into the new base
+                    i = side.buf.index(v, side.snap_buf)
+                    side.buf.pop(i)
                 except ValueError:
                     side.tomb.append(v)
         self.n_evicted += count
 
     def _maybe_compact(self) -> None:
         for side in (self._pos, self._neg):
-            if (len(side.buf) >= self.compact_every
-                    or len(side.tomb) >= self.compact_every):
-                self._compact_side(side)
+            buf_pending, tomb_pending = side.pending
+            if (buf_pending >= self.compact_every
+                    or tomb_pending >= self.compact_every):
+                if self.bg_compact:
+                    self._submit_compact(side)
+                else:
+                    self._compact_side(side)
+
+    def wait_idle(self, timeout: float = 30.0) -> None:
+        """Block until no background build is queued or in flight —
+        after this, pause/compaction metrics are settled (measurement
+        code calls it so records don't depend on compactor timing)."""
+        with self._cv:
+            while self._pos.building or self._neg.building:
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError("background compaction stuck")
 
     def compact(self) -> None:
-        """Force both sides into a single sorted base run."""
-        for side in (self._pos, self._neg):
-            if side.buf or side.tomb:
-                self._compact_side(side)
+        """Force both sides into a single sorted base run (drains any
+        in-flight background builds first)."""
+        with self._cv:
+            while self._pos.building or self._neg.building:
+                if not self._cv.wait(timeout=30.0):
+                    raise TimeoutError("background compaction stuck")
+            for side in (self._pos, self._neg):
+                if side.buf or side.tomb:
+                    self._compact_side(side)
 
-    def _compact_side(self, side: _ClassSide) -> None:
-        merged = np.concatenate(
-            [side.base, np.asarray(side.buf, dtype=self.dtype)])
-        n = len(merged)
-        if n:
-            if self.engine == "jax":
+    def _merge(self, side_base: np.ndarray, buf: List[float],
+               tomb: List[float], on_thread: bool) -> np.ndarray:
+        """Pure merge: sorted(base + buf) minus tombstones.
+
+        ``on_thread`` (synchronous jax compaction) keeps the padded
+        jitted sort — the caller already owns the device. Background
+        and sharded merges MUST stay off the device: a jitted sort
+        would serialize with the batcher's jitted searchsorted on the
+        same XLA stream, re-creating on the device the very pause the
+        side thread exists to remove. The host path exploits that base
+        is already sorted: sort only the buffer and splice it in at
+        its searchsorted positions — O(n + b log b), not O(n log n).
+        Values (hence counts) are identical either way.
+        """
+        buf_sorted = np.sort(np.asarray(buf, dtype=self.dtype))
+        if on_thread and self.engine == "jax" and self.shards is None:
+            merged = np.concatenate([side_base, buf_sorted])
+            n = len(merged)
+            if n:
                 b = _next_bucket(n)
                 padded = np.full(b, np.inf, dtype=self.dtype)
                 padded[:n] = merged
                 merged = np.asarray(_jit_sort_fn(b)(padded))[:n]
-            else:
-                merged = np.sort(merged, kind="stable")
-        side.base = _remove_sorted(merged, side.tomb)
+        elif len(buf_sorted) == 0:
+            merged = side_base
+        else:
+            merged = np.insert(
+                side_base, np.searchsorted(side_base, buf_sorted),
+                buf_sorted)
+        return _remove_sorted(merged, tomb)
+
+    def _place(self, side: _ClassSide) -> None:
+        """(Re)place the base run's device shards after it changed."""
+        if self.shards is None or len(side.base) == 0:
+            side.base_dev, side.cap = None, 0
+            return
+        from tuplewise_tpu.parallel.sharded_counts import place_base
+
+        side.base_dev, side.cap = place_base(
+            self._mesh, side.base, self.dtype)
+
+    def _compact_side(self, side: _ClassSide) -> None:
+        """Synchronous compaction (caller holds the lock): the merge —
+        and the pause it bills to the caller — spans the full sort."""
+        t0 = time.perf_counter()
+        side.base = self._merge(side.base, side.buf, side.tomb,
+                                on_thread=True)
         side.buf = []
         side.tomb = []
+        self._place(side)
         self.n_compactions += 1
+        self._c_compactions.inc()
+        self._h_pause.observe(time.perf_counter() - t0)
+
+    # ------------------------------------------------------------------ #
+    # background compaction                                              #
+    # ------------------------------------------------------------------ #
+    def _submit_compact(self, side: _ClassSide) -> None:
+        """Snapshot the side's consumable prefix and enqueue a build
+        (caller holds the lock); no-op while a build is in flight."""
+        if side.building:
+            return
+        side.building = True
+        side.snap_buf = len(side.buf)
+        side.snap_tomb = len(side.tomb)
+        self._jobs.put(side)
+
+    def _compact_worker(self) -> None:
+        while True:
+            side = self._jobs.get()
+            if side is None:
+                return
+            if self._bg_test_hook is not None:
+                self._bg_test_hook(side)
+            with self._cv:
+                base = side.base
+                buf_snap = list(side.buf[: side.snap_buf])
+                tomb_snap = list(side.tomb[: side.snap_tomb])
+            # the expensive part — merge + device placement — runs with
+            # the lock RELEASED; inserts keep landing in the buffer
+            merged = self._merge(base, buf_snap, tomb_snap,
+                                 on_thread=False)
+            if self.shards is not None and len(merged):
+                from tuplewise_tpu.parallel.sharded_counts import place_base
+
+                base_dev, cap = place_base(self._mesh, merged, self.dtype)
+            else:
+                base_dev, cap = None, 0
+            with self._cv:
+                t0 = time.perf_counter()
+                side.base = merged
+                side.base_dev, side.cap = base_dev, cap
+                del side.buf[: side.snap_buf]
+                del side.tomb[: side.snap_tomb]
+                side.snap_buf = side.snap_tomb = 0
+                side.building = False
+                self.n_compactions += 1
+                self._c_compactions.inc()
+                # the swap is the ONLY pause the hot path can observe
+                self._h_pause.observe(time.perf_counter() - t0)
+                # keep draining if the buffer outgrew the threshold
+                # while this build ran
+                buf_pending, tomb_pending = side.pending
+                if (not self._closed
+                        and (buf_pending >= self.compact_every
+                             or tomb_pending >= self.compact_every)):
+                    self._submit_compact(side)
+                self._cv.notify_all()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the background compactor (no-op in synchronous mode)."""
+        if not self.bg_compact or self._closed:
+            self._closed = True
+            return
+        self._closed = True
+        self._jobs.put(None)
+        self._compactor.join(timeout=timeout)
+
+    def __enter__(self) -> "ExactAucIndex":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # ------------------------------------------------------------------ #
     # queries                                                            #
     # ------------------------------------------------------------------ #
     @property
     def n_pos(self) -> int:
-        return self._pos.size
+        with self._cv:
+            return self._pos.size
 
     @property
     def n_neg(self) -> int:
-        return self._neg.size
+        with self._cv:
+            return self._neg.size
 
     @property
     def n_events(self) -> int:
-        return len(self._log)
+        with self._cv:
+            return len(self._log)
 
     def auc(self) -> Optional[float]:
         """Exact AUC of the current window; None until both classes
         have at least one member."""
-        if self.n_pos == 0 or self.n_neg == 0:
-            return None
-        return self._wins2 / (2.0 * self.n_pos * self.n_neg)
+        with self._cv:
+            if self._pos.size == 0 or self._neg.size == 0:
+                return None
+            return self._wins2 / (2.0 * self._pos.size * self._neg.size)
 
     def score_batch(self, scores) -> np.ndarray:
         """Fractional rank of each score against current negatives:
         (count_less + 0.5*count_eq) / n_neg — exactly the per-positive
         quantity ops.rank_auc averages. NaN when no negatives yet."""
         q = np.asarray(scores, dtype=self.dtype).ravel()
-        if self.n_neg == 0:
-            return np.full(len(q), np.nan)
-        less, eq = self._counts(self._neg, q)
-        return (less + 0.5 * eq) / float(self.n_neg)
+        with self._cv:
+            if self._neg.size == 0:
+                return np.full(len(q), np.nan)
+            less, eq = self._counts(self._neg, q)
+            return (less + 0.5 * eq) / float(self._neg.size)
 
     def oracle_values(self) -> Tuple[np.ndarray, np.ndarray]:
         """(pos, neg) multisets of the current window — feed these to
         the batch oracle in parity tests. O(n); not a hot path."""
-        return self._pos.values(), self._neg.values()
+        with self._cv:
+            return self._pos.values(), self._neg.values()
 
     def state(self) -> dict:
-        return {
-            "n_pos": self.n_pos,
-            "n_neg": self.n_neg,
-            "n_events": self.n_events,
-            "auc": self.auc(),
-            "n_compactions": self.n_compactions,
-            "n_evicted": self.n_evicted,
-            "buf_pos": len(self._pos.buf),
-            "buf_neg": len(self._neg.buf),
-            "engine": self.engine,
-            "window": self.window,
-        }
+        with self._cv:
+            return {
+                "n_pos": self._pos.size,
+                "n_neg": self._neg.size,
+                "n_events": len(self._log),
+                "auc": self.auc(),
+                "n_compactions": self.n_compactions,
+                "n_evicted": self.n_evicted,
+                "buf_pos": len(self._pos.buf),
+                "buf_neg": len(self._neg.buf),
+                "engine": self.engine,
+                "window": self.window,
+                "shards": self.shards,
+                "bg_compact": self.bg_compact,
+            }

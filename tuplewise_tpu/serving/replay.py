@@ -33,13 +33,20 @@ def make_stream(n_events: int, pos_frac: float = 0.5,
 
 def replay(scores, labels, config: Optional[ServingConfig] = None,
            score_every: int = 0, query_every: int = 0,
-           chunk: int = 1, warmup: bool = False, **overrides) -> dict:
+           chunk: int = 1, warmup: bool = False,
+           max_inflight: Optional[int] = None, **overrides) -> dict:
     """Drive the engine with one request per event (or per ``chunk``
     events) and return the measurement record.
 
     ``score_every`` / ``query_every``: interleave a score / query
     request every k events (0 = never) — the mixed-workload case the
     batcher's kind-run coalescing exists for.
+
+    ``max_inflight``: bound the number of outstanding requests (the
+    submitter waits for the oldest future past the bound). Unbounded
+    submission saturates the queue, so latency percentiles measure
+    BACKLOG, not per-event cost; a bounded closed loop is what exposes
+    pause spikes (compaction) in p99 while keeping the engine busy.
 
     ``warmup=True`` replays the stream once through a throwaway engine
     first, so the timed run measures the steady state: the index's
@@ -54,7 +61,8 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     cfg = config or ServingConfig(**overrides)
     if warmup:
         replay(scores, labels, config=cfg, score_every=score_every,
-               query_every=query_every, chunk=chunk, warmup=False)
+               query_every=query_every, chunk=chunk, warmup=False,
+               max_inflight=max_inflight)
     rejected = 0
     futures = []
     with MicroBatchEngine(cfg) as eng:
@@ -65,6 +73,11 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
                 futures.append(eng.insert(scores[i:j], labels[i:j]))
             except BackpressureError:
                 rejected += j - i
+            if max_inflight and len(futures) >= max_inflight:
+                try:
+                    futures[len(futures) - max_inflight].result(timeout=60.0)
+                except BackpressureError:
+                    pass    # counted in the final wait below
             if score_every and (i // chunk) % score_every == score_every - 1:
                 try:
                     futures.append(eng.score(scores[i:j]))
@@ -83,11 +96,22 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             except BackpressureError:
                 dropped += 1
         wall = time.perf_counter() - t0
+        if eng.index is not None and cfg.bg_compact:
+            # settle in-flight background builds OUTSIDE the timed
+            # window so compaction/pause fields are deterministic
+            eng.index.wait_idle()
         stats = eng.stats()
 
     lat = stats["metrics"]["request_latency_s"]
+    ins = stats["metrics"].get("insert_latency_s", {})
+    pause = stats["metrics"].get("compaction_pause_s", {})
     fill = stats["metrics"]["batch_fill"]
     applied = stats["metrics"]["events_total"]["value"]
+
+    def _ms(snap, q):
+        v = snap.get(q)
+        return None if v is None else v * 1e3
+
     rec = {
         "n_events": n,
         "events_applied": int(applied),
@@ -95,8 +119,17 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
         "requests_dropped": int(dropped),
         "wall_s": wall,
         "events_per_s": applied / wall if wall > 0 else None,
-        "latency_p50_ms": None if lat["p50"] is None else lat["p50"] * 1e3,
-        "latency_p99_ms": None if lat["p99"] is None else lat["p99"] * 1e3,
+        "latency_p50_ms": _ms(lat, "p50"),
+        "latency_p99_ms": _ms(lat, "p99"),
+        # per-event insert latency: the compaction-pause story lives in
+        # the gap between p50 and p99 of THIS histogram
+        "insert_latency_p50_ms": _ms(ins, "p50"),
+        "insert_latency_p95_ms": _ms(ins, "p95"),
+        "insert_latency_p99_ms": _ms(ins, "p99"),
+        "insert_latency_max_ms": _ms(ins, "max"),
+        "compactions": pause.get("count", 0),
+        "compaction_pause_p99_ms": _ms(pause, "p99"),
+        "compaction_pause_max_ms": _ms(pause, "max"),
         "batches": stats["metrics"]["batches_total"]["value"],
         "mean_batch_fill": fill["mean"],
         "auc_exact": stats.get("auc_exact"),
@@ -111,6 +144,7 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             "flush_timeout_s": cfg.flush_timeout_s,
             "queue_size": cfg.queue_size, "policy": cfg.policy,
             "engine": cfg.engine, "chunk": chunk,
+            "mesh_shards": cfg.mesh_shards, "bg_compact": cfg.bg_compact,
         },
     }
     # oracle parity of the final exact estimate (windowed: oracle over

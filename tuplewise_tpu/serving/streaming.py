@@ -47,15 +47,34 @@ class _Reservoir:
         self.seen = 0
 
     def add_batch(self, values: np.ndarray) -> None:
-        for v in values:
-            self.seen += 1
-            if self.size < self.capacity:
-                self.items[self.size] = v
-                self.size += 1
-            else:
-                j = int(self._rng.integers(0, self.seen))
-                if j < self.capacity:
-                    self.items[j] = v
+        """Fold a batch in, vectorized but sequentially-exact.
+
+        Algorithm R keeps item t with probability capacity/seen_t at a
+        uniform slot. The per-item slot draws j_t ~ U[0, seen_t) are
+        independent, so one broadcast ``integers`` call with the
+        per-item bounds replaces the Python loop; duplicate accepted
+        slots resolve last-write-wins under NumPy fancy assignment —
+        exactly the sequential overwrite order. The hot path drops from
+        O(batch) interpreter iterations to three array ops.
+        """
+        values = np.asarray(values, dtype=np.float64).ravel()
+        if len(values) == 0:
+            return
+        take = 0
+        if self.size < self.capacity:           # fill phase
+            take = min(self.capacity - self.size, len(values))
+            self.items[self.size: self.size + take] = values[:take]
+            self.size += take
+            self.seen += take
+        rest = values[take:]
+        if len(rest) == 0:
+            return
+        bounds = self.seen + 1 + np.arange(len(rest))
+        js = self._rng.integers(0, bounds)      # one draw per arrival
+        self.seen += len(rest)
+        hit = js < self.capacity
+        if hit.any():
+            self.items[js[hit]] = rest[hit]
 
     def sample(self, k: int, replace: bool = True) -> np.ndarray:
         if self.size == 0:

@@ -191,7 +191,8 @@ class Histogram:
                 for i, c in enumerate(counts) if c
             },
         }
-        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
+                         (0.99, "p99")):
             out[label] = self.quantile(q)
         return out
 
