@@ -16,7 +16,10 @@ parity check, and ``p99_insert_vs_sync_compact`` — the p99 win of the
 background compactor over on-thread compaction at the same config
 [ISSUE 2] — ride along in the same record. Submission is a bounded
 closed loop (``--max-inflight``), so percentiles price per-event cost
-rather than queue backlog.
+rather than queue backlog. ``--chaos`` [ISSUE 3] reruns the streaming
+bench under a seeded fault schedule (compactor crash, batcher crash,
+poison events) and adds the recovery counters + admitted-events parity
+to the record — throughput WITH failures, not just without.
 
 `value` is the complete-AUC pair-kernel throughput of the JAX/TPU tiled
 reduction on one chip (BASELINE.json:2's metric). The reference repo
@@ -219,10 +222,21 @@ def _numpy_pairs_per_sec(n=16384, reps=3):
     return (n * n) / dt
 
 
+# Default --chaos schedule: one compactor crash, one batcher crash, and
+# a few poison events — the recovery paths a serving deploy actually
+# exercises, at bench scale. Shard death needs a multi-device mesh, so
+# it lives in the CI chaos smoke / tests instead of the bench default.
+_CHAOS_BENCH_SPEC = {"faults": [
+    {"point": "compactor_build", "on_call": 1, "action": "error"},
+    {"point": "batcher", "on_call": 50, "action": "error"},
+    {"point": "poison", "at_events": [1000, 2500, 4000], "value": "nan"},
+]}
+
+
 def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
                               window=None, baseline_events=2_000,
                               bg_compact=True, max_inflight=64,
-                              flush_timeout_s=0.0005):
+                              flush_timeout_s=0.0005, chaos=None):
     """Micro-batched serving throughput + unbatched baseline + the
     on-thread-compaction latency comparison.
 
@@ -245,7 +259,7 @@ def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
                         policy="block", flush_timeout_s=flush_timeout_s,
                         compact_every=1024, bg_compact=bg_compact)
     rec = replay(scores, labels, config=cfg, warmup=True,
-                 max_inflight=max_inflight)
+                 max_inflight=max_inflight, chaos=chaos)
     print(
         f"[bench] streaming n={n_events} batched (bg_compact="
         f"{bg_compact}): "
@@ -282,12 +296,18 @@ def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
 
 
 def _streaming_main(args):
+    chaos = None
+    if args.chaos:
+        from tuplewise_tpu.testing.chaos import FaultInjector
+
+        chaos = FaultInjector.from_spec(
+            args.chaos_spec or _CHAOS_BENCH_SPEC)
     rec, base, sync = _streaming_events_per_sec(
         n_events=args.n_events, budget=args.budget,
         max_batch=args.max_batch, window=args.window,
         baseline_events=args.baseline_events,
         bg_compact=not args.sync_compact,
-        max_inflight=args.max_inflight,
+        max_inflight=args.max_inflight, chaos=chaos,
     )
     out = {
         "metric": "events/sec",
@@ -311,6 +331,12 @@ def _streaming_main(args):
         "auc_abs_err": rec.get("auc_abs_err"),
         "n_events": rec["n_events"],
     }
+    if chaos is not None:
+        # the bench doubles as a chaos harness [ISSUE 3]: throughput
+        # under a seeded fault schedule, plus the recovery counters and
+        # the (admitted-events) oracle parity in the same record
+        out["faults"] = rec.get("faults")
+        out["events_poison_rejected"] = rec.get("events_poison_rejected")
     if sync is not None:
         out["sync_compact_insert_p99_ms"] = sync["insert_latency_p99_ms"]
         out["sync_compact_pause_p99_ms"] = sync["compaction_pause_p99_ms"]
@@ -345,6 +371,14 @@ def main():
     ap.add_argument("--sync-compact", action="store_true",
                     help="compact on the batcher thread (pre-PR2 "
                          "behavior); skips the sync comparison run")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the streaming bench under a seeded fault "
+                         "schedule (compactor crash + batcher crash + "
+                         "poison events); adds recovery counters to the "
+                         "record")
+    ap.add_argument("--chaos-spec", type=str, default=None,
+                    help="override the default --chaos schedule (JSON "
+                         "inline, @file, or *.json path)")
     args = ap.parse_args()
     if args.streaming:
         _streaming_main(args)

@@ -23,4 +23,14 @@ if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 # oracle; writes results/serving_smoke.jsonl for the CI artifact.
 timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python scripts/streaming_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Chaos smoke [ISSUE 3]: a seeded fault schedule (shard death +
+# compactor crash + batcher crash + poison events) through replay;
+# asserts every recovery counter fired and the final AUC is
+# bit-identical to the fault-free run on the same admitted events;
+# writes results/chaos_smoke.jsonl for the CI artifact.
+timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/chaos_smoke.py
 exit $?

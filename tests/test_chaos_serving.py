@@ -1,0 +1,425 @@
+"""Serving fault tolerance [ISSUE 3]: deterministic chaos schedules,
+self-healing sharded counts, engine lifecycle hardening, and crash-safe
+recovery.
+
+The invariant every test pins: recovery REPAIRS state, it never
+corrupts it — under any scheduled fault (shard death, compactor crash,
+batcher crash, poison events) the engine completes without hanging and
+wins2 / AUC stay bit-identical to a fault-free run over the same
+admitted events. Crash recovery extends the same claim across a
+process boundary: snapshot + WAL replay reproduce the uninterrupted
+run's every subsequent prefix bit-for-bit.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.serving import (
+    DeadlineExceededError,
+    EngineClosedError,
+    ExactAucIndex,
+    MicroBatchEngine,
+    PoisonEventError,
+    ServingConfig,
+    replay,
+)
+from tuplewise_tpu.serving.replay import make_stream
+from tuplewise_tpu.testing.chaos import FaultInjector, InjectedFault
+
+
+def _stream(n, seed=7):
+    scores, labels = make_stream(n, pos_frac=0.45, separation=1.0,
+                                 seed=seed)
+    return scores, labels
+
+
+# --------------------------------------------------------------------- #
+# chaos injector                                                        #
+# --------------------------------------------------------------------- #
+class TestFaultInjector:
+    def test_fires_at_scheduled_call_once(self):
+        inj = FaultInjector.from_spec(
+            {"faults": [{"point": "batcher", "on_call": 3}]})
+        inj.fire("batcher")
+        inj.fire("batcher")
+        with pytest.raises(InjectedFault):
+            inj.fire("batcher")
+        inj.fire("batcher")     # one-shot: no refire
+        assert inj.snapshot()["fired"] == {"batcher": 1}
+
+    def test_poison_batch_positions(self):
+        inj = FaultInjector.from_spec(
+            {"faults": [{"point": "poison", "at_events": [5, 12],
+                         "value": "nan"}]})
+        arr = np.zeros(10)
+        out, k = inj.poison_batch(0, arr)
+        assert k == 1 and np.isnan(out[5]) and not np.isnan(arr[5])
+        out, k = inj.poison_batch(10, np.zeros(10))
+        assert k == 1 and np.isnan(out[2])
+
+    def test_spec_rejects_unknown_point(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FaultInjector.from_spec({"faults": [{"point": "nope"}]})
+
+    def test_random_is_reproducible(self):
+        a = FaultInjector.random(3, 1000)
+        b = FaultInjector.random(3, 1000)
+        assert a.poison_at == b.poison_at
+
+
+# --------------------------------------------------------------------- #
+# self-healing sharded index                                            #
+# --------------------------------------------------------------------- #
+class TestShardDeath:
+    def test_self_heal_preserves_exactness(self):
+        """A device error mid-query triggers probe -> reshard over the
+        survivors -> re-place -> retry; counts (hence wins2 and every
+        AUC) stay bit-identical to the unfaulted single-host index."""
+        scores, labels = _stream(1200, seed=11)
+        inj = FaultInjector.from_spec({"faults": [
+            {"point": "sharded_count", "on_call": 7, "action": "error",
+             "dropped": [1]}]})
+        hurt = ExactAucIndex(engine="jax", compact_every=64, shards=2,
+                             chaos=inj)
+        plain = ExactAucIndex(engine="jax", compact_every=64)
+        for i in range(0, 1200, 41):
+            j = min(i + 41, 1200)
+            hurt.insert_batch(scores[i:j], labels[i:j])
+            plain.insert_batch(scores[i:j], labels[i:j])
+            assert hurt._wins2 == plain._wins2, i
+        assert hurt.auc() == plain.auc()
+        assert hurt.shards == 1            # resharded over the survivor
+        m = hurt.metrics.snapshot()
+        assert m["reshard_events"]["value"] == 1
+        assert m["shard_retries_total"]["value"] == 1
+        assert m["recovery_time_s"]["count"] == 1
+        hurt.close()
+        plain.close()
+
+    def test_retry_bound_surfaces_persistent_failure(self):
+        """A fault on EVERY retry exhausts the bound and raises — the
+        index degrades loudly, never spins forever."""
+        scores, labels = _stream(100, seed=1)
+        faults = [{"point": "sharded_count", "on_call": k,
+                   "action": "error"} for k in range(1, 10)]
+        idx = ExactAucIndex(engine="jax", compact_every=8, shards=2,
+                            chaos=FaultInjector.from_spec(
+                                {"faults": faults}),
+                            shard_retries=2, retry_backoff_s=0.001)
+        # base run must be non-empty for the sharded path to engage
+        idx.insert_batch(scores[:32], labels[:32])
+        idx.compact()
+        with pytest.raises(InjectedFault):
+            idx.insert_batch(scores[32:64], labels[32:64])
+        idx.close()
+
+
+# --------------------------------------------------------------------- #
+# compactor watchdog                                                    #
+# --------------------------------------------------------------------- #
+class TestCompactorCrash:
+    def test_watchdog_restarts_and_statistic_survives(self):
+        scores, labels = _stream(2000, seed=3)
+        inj = FaultInjector.from_spec({"faults": [
+            {"point": "compactor_build", "on_call": 1,
+             "action": "error"}]})
+        hurt = ExactAucIndex(engine="numpy", compact_every=32,
+                             bg_compact=True, chaos=inj)
+        sync = ExactAucIndex(engine="numpy", compact_every=32)
+        for i in range(0, 2000, 37):
+            j = min(i + 37, 2000)
+            hurt.insert_batch(scores[i:j], labels[i:j])
+            sync.insert_batch(scores[i:j], labels[i:j])
+            assert hurt._wins2 == sync._wins2, i
+        hurt.compact()      # must not hang on the crashed build
+        assert hurt.auc() == sync.auc()
+        m = hurt.metrics.snapshot()
+        assert m["bg_compactor_restarts"]["value"] >= 1
+        assert hurt.n_compactions > 0
+        assert "InjectedFault" in hurt.state()["last_compactor_error"]
+        hurt.close()
+
+    def test_wait_idle_survives_crashed_build(self):
+        """wait_idle during a crashed build must resolve (watchdog
+        restart), not time out."""
+        scores, labels = _stream(400, seed=9)
+        inj = FaultInjector.from_spec({"faults": [
+            {"point": "compactor_build", "on_call": 1,
+             "action": "error"}]})
+        idx = ExactAucIndex(engine="numpy", compact_every=32,
+                            bg_compact=True, chaos=inj)
+        idx.insert_batch(scores, labels)
+        idx.wait_idle(timeout=10.0)
+        idx.close()
+
+
+# --------------------------------------------------------------------- #
+# engine lifecycle                                                      #
+# --------------------------------------------------------------------- #
+class TestEngineHardening:
+    def test_poison_rejected_at_edge(self):
+        with MicroBatchEngine(engine="numpy", policy="block") as eng:
+            with pytest.raises(PoisonEventError, match="non-finite"):
+                eng.insert([np.nan, 1.0], [1, 0])
+            with pytest.raises(PoisonEventError, match="mismatch"):
+                eng.insert([1.0, 2.0], [1])
+            eng.insert([1.0, 0.0], [1, 0]).result(10)
+            snap = eng.flush()
+        assert snap["metrics"]["poison_rejects"]["value"] == 2
+        assert snap["index"]["n_events"] == 2   # poison never landed
+
+    def test_block_policy_close_wakes_producers(self):
+        """[ISSUE 3 satellite] close() with producers blocked on the
+        bounded queue: every blocked producer must wake and see a typed
+        EngineClosedError, not deadlock."""
+        eng = MicroBatchEngine(engine="numpy", policy="block",
+                               queue_size=2, max_batch=1,
+                               flush_timeout_s=0.0)
+        orig = eng._apply_inserts
+
+        def slow(run):
+            # hold the batcher long enough for close() to land while
+            # producers are still blocked on the full queue
+            time.sleep(0.4)
+            orig(run)
+        eng._apply_inserts = slow
+        eng.insert([0.0], [0])          # occupies the batcher
+        time.sleep(0.05)
+        outcomes = []
+
+        def producer(i):
+            try:
+                f = eng.insert([float(i)], [i % 2])
+                try:
+                    f.result(10.0)
+                    outcomes.append("ok")
+                except EngineClosedError:
+                    outcomes.append("closed")
+            except EngineClosedError:
+                outcomes.append("closed")
+        threads = [threading.Thread(target=producer, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)                 # let them pile onto the queue
+        eng.close(timeout=10.0)         # pre-fix: deadlocked right here
+        for t in threads:
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "producer deadlocked through close()"
+        assert outcomes and set(outcomes) == {"closed"}
+        assert len(outcomes) == 6
+
+    def test_batcher_supervisor_restarts(self):
+        inj = FaultInjector.from_spec({"faults": [
+            {"point": "batcher", "on_call": 2, "action": "error"}]})
+        with MicroBatchEngine(ServingConfig(engine="numpy",
+                                            policy="block"),
+                              chaos=inj) as eng:
+            for i in range(10):
+                eng.insert([float(i)], [i % 2]).result(10)
+            snap = eng.flush()
+        assert snap["metrics"]["batcher_restarts"]["value"] >= 1
+        assert snap["index"]["n_events"] == 10
+
+    def test_deadline_expires_stale_requests(self):
+        eng = MicroBatchEngine(engine="numpy", policy="block",
+                               deadline_s=0.05, max_batch=4,
+                               flush_timeout_s=0.0)
+        release = threading.Event()
+        orig = eng._apply_inserts
+
+        def slow(run):
+            release.wait(timeout=10.0)
+            orig(run)
+        eng._apply_inserts = slow
+        first = eng.insert([0.0], [0])      # holds the batcher...
+        time.sleep(0.2)                     # ...past the deadline
+        late = eng.insert([1.0], [1])
+        time.sleep(0.2)
+        release.set()
+        eng._apply_inserts = orig
+        with pytest.raises(DeadlineExceededError):
+            late.result(10.0)
+        first.result(10.0)      # already dispatched: deadline unchecked
+        snap = eng.flush()
+        eng.close()
+        assert snap["metrics"]["deadline_expired_total"]["value"] >= 1
+
+    def test_submit_after_close_is_typed(self):
+        eng = MicroBatchEngine(engine="numpy")
+        eng.close()
+        with pytest.raises(EngineClosedError):
+            eng.insert([1.0], [1])
+
+
+# --------------------------------------------------------------------- #
+# chaos parity (the acceptance schedule)                                #
+# --------------------------------------------------------------------- #
+class TestChaosParity:
+    def test_combined_schedule_bit_identical(self):
+        """Shard death + compactor crash + poison in ONE replay: it
+        completes, every recovery counter fires, and the final AUC is
+        bit-identical to the fault-free run on the admitted events."""
+        n = 1200
+        scores, labels = _stream(n, seed=21)
+        spec = {"faults": [
+            {"point": "sharded_count", "on_call": 25, "action": "error",
+             "dropped": [1]},
+            {"point": "compactor_build", "on_call": 1,
+             "action": "error"},
+            {"point": "poison", "at_events": [77, 500, 501],
+             "value": "nan"},
+        ]}
+        cfg = ServingConfig(policy="block", mesh_shards=2,
+                            bg_compact=True, compact_every=64)
+        rec = replay(scores, labels, config=cfg, chaos=spec,
+                     max_inflight=64)
+        f = rec["faults"]
+        assert f["reshard_events"] > 0
+        assert f["bg_compactor_restarts"] > 0
+        assert f["poison_rejects"] == 3
+        assert rec["shed_events"] == [77, 500, 501]
+        assert rec["auc_abs_err"] == 0.0    # oracle over admitted events
+        admitted = np.ones(n, dtype=bool)
+        admitted[rec["shed_events"]] = False
+        ref = replay(scores[admitted], labels[admitted],
+                     config=ServingConfig(policy="block",
+                                          bg_compact=True,
+                                          compact_every=64),
+                     max_inflight=64)
+        assert rec["auc_exact"] == ref["auc_exact"]
+
+    @pytest.mark.slow
+    def test_randomized_soak(self):
+        """Randomized-but-reproducible schedules: whatever fires, the
+        engine completes and parity holds on the admitted events."""
+        n = 1500
+        for seed in range(8):
+            scores, labels = _stream(n, seed=100 + seed)
+            inj = FaultInjector.random(seed, n)
+            cfg = ServingConfig(engine="numpy", policy="block",
+                                bg_compact=True, compact_every=64)
+            rec = replay(scores, labels, config=cfg, chaos=inj,
+                         max_inflight=64)
+            admitted = np.ones(n, dtype=bool)
+            admitted[rec["shed_events"]] = False
+            ref = replay(scores[admitted], labels[admitted],
+                         config=cfg, max_inflight=64)
+            assert rec["auc_exact"] == ref["auc_exact"], seed
+
+
+# --------------------------------------------------------------------- #
+# crash-safe recovery                                                   #
+# --------------------------------------------------------------------- #
+class TestCrashRecovery:
+    def _ref_index(self, scores, labels):
+        idx = ExactAucIndex(engine="numpy", compact_every=64)
+        idx.insert_batch(scores, labels)
+        return idx
+
+    def test_recover_resumes_bit_identical(self, tmp_path):
+        """Abandon an engine mid-stream (daemon threads — a process
+        crash in miniature), recover from its snapshot + WAL, continue:
+        every subsequent prefix must match the uninterrupted run
+        bit-for-bit."""
+        d = str(tmp_path / "reco")
+        scores, labels = _stream(1400, seed=5)
+        cfg = ServingConfig(engine="numpy", policy="block",
+                            snapshot_dir=d, snapshot_every=300,
+                            compact_every=64)
+        eng = MicroBatchEngine(cfg)
+        for i in range(0, 700, 7):
+            eng.insert(scores[i:i + 7], labels[i:i + 7])
+        eng.flush()
+        del eng     # crash: no close(), no final snapshot
+
+        eng2 = MicroBatchEngine(ServingConfig(
+            engine="numpy", policy="block", snapshot_dir=d,
+            snapshot_every=300, compact_every=64, recover=True))
+        assert eng2._recovery.seq == 700
+        ref = ExactAucIndex(engine="numpy", compact_every=64)
+        ref.insert_batch(scores[:700], labels[:700])
+        assert eng2.index._wins2 == ref._wins2
+        for i in range(700, 1400, 11):
+            j = min(i + 11, 1400)
+            eng2.insert(scores[i:j], labels[i:j]).result(10)
+            eng2.flush()
+            ref.insert_batch(scores[i:j], labels[i:j])
+            assert eng2.index._wins2 == ref._wins2, i
+            assert eng2.index.auc() == ref.auc(), i
+        # the incomplete-U estimator recovered too (sums + reservoirs +
+        # RNG state round-trip through the snapshot)
+        assert eng2.streaming.n_arrivals == 1400
+        eng2.close()
+
+    def test_recover_rejects_mismatched_config(self, tmp_path):
+        d = str(tmp_path / "reco2")
+        scores, labels = _stream(100, seed=2)
+        eng = MicroBatchEngine(ServingConfig(
+            engine="numpy", policy="block", snapshot_dir=d,
+            snapshot_every=50))
+        eng.insert(scores, labels).result(10)
+        eng.flush()
+        eng.close()     # graceful: final snapshot
+        with pytest.raises(ValueError, match="config mismatch"):
+            MicroBatchEngine(ServingConfig(
+                engine="numpy", policy="block", snapshot_dir=d,
+                window=10, recover=True))
+
+    def test_sigkill_mid_stream_recovers(self, tmp_path):
+        """The real thing: SIGKILL a serve process mid-stream, restart
+        with --recover, finish the stream — the final AUC must equal
+        the uninterrupted in-process run bit-for-bit."""
+        d = str(tmp_path / "rk")
+        scores, labels = _stream(600, seed=13)
+        lines = [json.dumps({"op": "insert", "score": float(s),
+                             "label": int(b)})
+                 for s, b in zip(scores, labels)]
+        args = [sys.executable, "-m", "tuplewise_tpu.harness.cli",
+                "serve", "--engine", "numpy", "--policy", "block",
+                "--snapshot-dir", d, "--snapshot-every", "100",
+                "--compact-every", "64"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        p1 = subprocess.Popen(args, stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE, text=True,
+                              env=env, cwd=repo)
+        for ln in lines[:350]:
+            p1.stdin.write(ln + "\n")
+        p1.stdin.flush()
+        # wait until all 350 are ACKed (responses are 1:1, in order),
+        # so the WAL provably holds every admitted event, then KILL
+        for _ in range(350):
+            assert json.loads(p1.stdout.readline())["ok"]
+        os.kill(p1.pid, signal.SIGKILL)
+        p1.wait(timeout=30)
+
+        # resume, interleaving a query every 50 events: EVERY subsequent
+        # prefix must match the uninterrupted run bit-for-bit
+        feed, query_prefixes = [], []
+        for k in range(350, 600):
+            feed.append(lines[k])
+            if (k + 1) % 50 == 0 or k == 599:
+                feed.append(json.dumps({"op": "query"}))
+                query_prefixes.append(k + 1)
+        p2 = subprocess.Popen(args + ["--recover"],
+                              stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE, text=True,
+                              env=env, cwd=repo)
+        out, _ = p2.communicate("\n".join(feed) + "\n", timeout=120)
+        resp = [json.loads(ln) for ln in out.strip().splitlines()]
+        assert all(r["ok"] for r in resp)
+        aucs = [r["auc_exact"] for r in resp if "auc_exact" in r]
+        assert len(aucs) == len(query_prefixes)
+        for prefix, got in zip(query_prefixes, aucs):
+            ref = self._ref_index(scores[:prefix], labels[:prefix])
+            assert got == ref.auc(), prefix

@@ -235,6 +235,52 @@ class TestEndToEndFaultTolerance:
         assert v == est.local_average(s1, s2, seed=1)
 
 
+class TestProbeTimeout:
+    """[ISSUE 3 satellite] A HUNG device blocks forever instead of
+    raising — the detector must bound the probe, or it becomes the very
+    hang it exists to detect."""
+
+    def test_hung_collective_reports_unhealthy(self, monkeypatch):
+        import time
+
+        import tuplewise_tpu.parallel.faults as faults
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.setattr(faults, "_collective_probe",
+                            lambda mesh: time.sleep(60))
+        t0 = time.monotonic()
+        assert faults.check_mesh_health(make_mesh(1),
+                                        timeout_s=0.2) is False
+        assert time.monotonic() - t0 < 5.0
+
+    def test_hung_device_counted_dropped(self, monkeypatch):
+        import time
+
+        import tuplewise_tpu.parallel.faults as faults
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(2)
+        hung = mesh.devices.flat[1]
+        monkeypatch.setattr(faults, "_collective_probe",
+                            lambda mesh: False)
+
+        def probe(dev):
+            if dev is hung:
+                time.sleep(60)
+            return True
+
+        monkeypatch.setattr(faults, "_device_probe", probe)
+        t0 = time.monotonic()
+        assert faults.detect_dropped_workers(mesh, timeout_s=0.2) == (1,)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_no_timeout_keeps_sync_path(self):
+        from tuplewise_tpu.parallel.faults import check_mesh_health
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        assert check_mesh_health(make_mesh(1))   # timeout_s=None
+
+
 class TestFaults2DMesh:
     @needs_mesh
     def test_drop_renormalize_on_2d_mesh(self):

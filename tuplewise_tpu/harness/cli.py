@@ -75,12 +75,15 @@ def _emit(results, out):
         write_jsonl(results, out)
 
 
-def _serve_stdin(cfg) -> int:
+def _serve_stdin(cfg, chaos=None) -> int:
     """The ``serve`` loop: one JSONL request per stdin line, one JSONL
     response per stdout line (same order); final stats to stderr."""
-    from tuplewise_tpu.serving import BackpressureError, MicroBatchEngine
+    from tuplewise_tpu.serving import (
+        BackpressureError, DeadlineExceededError, EngineClosedError,
+        MicroBatchEngine, PoisonEventError,
+    )
 
-    with MicroBatchEngine(cfg) as eng:
+    with MicroBatchEngine(cfg, chaos=chaos) as eng:
         for line in sys.stdin:
             line = line.strip()
             if not line:
@@ -106,28 +109,45 @@ def _serve_stdin(cfg) -> int:
                             "state": snap.get("index")}
                 else:
                     resp = {"ok": False, "error": f"unknown op {op!r}"}
+            except PoisonEventError as e:
+                resp = {"ok": False, "error": f"poison: {e}"}
             except BackpressureError as e:
                 resp = {"ok": False, "error": f"backpressure: {e}"}
+            except DeadlineExceededError as e:
+                resp = {"ok": False, "error": f"deadline: {e}"}
+            except EngineClosedError as e:
+                resp = {"ok": False, "error": f"closed: {e}"}
             except (KeyError, ValueError, json.JSONDecodeError) as e:
                 resp = {"ok": False, "error": f"bad request: {e}"}
             print(json.dumps(resp), flush=True)
         stats = eng.stats()
     m = stats["metrics"]
 
+    def _v(name):
+        return m.get(name, {}).get("value", 0)
+
     def _p(name, q):
         v = m.get(name, {}).get(q)
         return None if v is None else round(v * 1e3, 3)
 
-    # exit summary: the load-shedding and pause numbers an operator
-    # grep for first, ahead of the full metrics dump
+    # exit summary: the load-shedding, pause, and recovery numbers an
+    # operator greps for first, ahead of the full metrics dump
     summary = {
-        "rejected_total": m.get("rejected_total", {}).get("value", 0),
-        "dropped_total": m.get("dropped_total", {}).get("value", 0),
-        "compactions_total": m.get("compactions_total", {}).get("value", 0),
+        "rejected_total": _v("rejected_total"),
+        "dropped_total": _v("dropped_total"),
+        "compactions_total": _v("compactions_total"),
         "compaction_pause_p99_ms": _p("compaction_pause_s", "p99"),
         "compaction_pause_max_ms": _p("compaction_pause_s", "max"),
         "insert_latency_p99_ms": _p("insert_latency_s", "p99"),
+        # fault-tolerance counters [ISSUE 3]
+        "reshard_events": _v("reshard_events"),
+        "bg_compactor_restarts": _v("bg_compactor_restarts"),
+        "batcher_restarts": _v("batcher_restarts"),
+        "poison_rejects": _v("poison_rejects"),
+        "deadline_expired_total": _v("deadline_expired_total"),
     }
+    if chaos is not None:
+        summary["chaos"] = chaos.snapshot()
     print(json.dumps({"exit_summary": summary}), file=sys.stderr)
     print(json.dumps({"final_stats": m}), file=sys.stderr)
     return 0
@@ -228,6 +248,22 @@ def main(argv=None) -> int:
         p.add_argument("--queue-size", type=int, default=1024)
         p.add_argument("--policy", default="reject",
                        choices=["reject", "drop_oldest", "block"])
+        p.add_argument("--deadline-ms", type=float, default=None,
+                       help="fail requests older than this at dispatch "
+                            "(typed DeadlineExceededError)")
+        p.add_argument("--chaos-spec", type=str, default=None,
+                       help="deterministic fault schedule (JSON inline, "
+                            "@file, or *.json path) injected into the "
+                            "serving stack's hook points "
+                            "(testing.chaos.FaultInjector)")
+        p.add_argument("--snapshot-dir", type=str, default=None,
+                       help="crash-safe recovery directory: periodic "
+                            "atomic index snapshots + an event-tail WAL")
+        p.add_argument("--snapshot-every", type=int, default=4096,
+                       help="events between snapshots")
+        p.add_argument("--recover", action="store_true",
+                       help="restore --snapshot-dir state (snapshot + "
+                            "WAL tail) before serving")
         p.add_argument("--seed", type=int, default=0)
 
     p = sub.add_parser(
@@ -266,8 +302,17 @@ def main(argv=None) -> int:
             bg_compact=args.bg_compact, max_batch=args.max_batch,
             flush_timeout_s=args.flush_timeout_ms / 1e3,
             queue_size=args.queue_size, policy=args.policy,
+            deadline_s=(args.deadline_ms / 1e3
+                        if args.deadline_ms is not None else None),
+            snapshot_dir=args.snapshot_dir,
+            snapshot_every=args.snapshot_every, recover=args.recover,
             seed=args.seed,
         )
+        chaos = None
+        if args.chaos_spec:
+            from tuplewise_tpu.testing.chaos import FaultInjector
+
+            chaos = FaultInjector.from_spec(args.chaos_spec)
         if args.cmd == "replay":
             from tuplewise_tpu.serving import make_stream, replay
 
@@ -277,11 +322,11 @@ def main(argv=None) -> int:
             _emit(
                 replay(scores, labels, config=cfg, chunk=args.chunk,
                        score_every=args.score_every,
-                       query_every=args.query_every),
+                       query_every=args.query_every, chaos=chaos),
                 args.out,
             )
             return 0
-        return _serve_stdin(cfg)
+        return _serve_stdin(cfg, chaos=chaos)
 
     if args.cmd == "variance":
         _emit(

@@ -26,9 +26,47 @@ currency the paper trades in [SURVEY §1.2].
 
 from __future__ import annotations
 
-from typing import Iterable, Sequence, Tuple
+import threading
+from typing import Callable, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
+
+
+class ProbeTimeout(RuntimeError):
+    """A health probe did not return within its deadline — the device
+    (or collective) is treated as hung, which is a failure mode, not an
+    exception to swallow silently."""
+
+
+def _run_bounded(fn: Callable[[], object],
+                 timeout_s: Optional[float]) -> object:
+    """Run ``fn`` with a wall-clock bound [ISSUE 3 satellite].
+
+    A *hung* device does not raise — it blocks forever, which would
+    turn the failure detector itself into the hang it exists to detect.
+    The probe runs in a daemon helper thread; if it misses the deadline
+    the caller gets ``ProbeTimeout`` and the thread is abandoned (it
+    holds no locks of ours; a wedged XLA collective cannot be cancelled
+    from Python anyway). ``timeout_s`` of None keeps the old synchronous
+    behavior."""
+    if timeout_s is None:
+        return fn()
+    box: dict = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:      # noqa: BLE001 — relayed below
+            box["exc"] = e
+
+    t = threading.Thread(target=run, name="tuplewise-probe", daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        raise ProbeTimeout(f"health probe hung past {timeout_s}s")
+    if "exc" in box:
+        raise box["exc"]
+    return box["value"]
 
 
 def normalize_dropped(
@@ -76,11 +114,10 @@ def survivors(n_workers: int, dropped: Sequence[int]) -> Tuple[int, ...]:
     return tuple(w for w in range(n_workers) if w not in d)
 
 
-def check_mesh_health(mesh) -> bool:
-    """Failure detection probe: every device contributes 1 to a psum;
-    a healthy N-device mesh returns N everywhere. Raises nothing itself —
-    runtime errors from dead devices propagate to the caller, which
-    should translate them (or a False return) into a dropped set."""
+def _collective_probe(mesh) -> bool:
+    """The raw psum probe body — separated so the timeout wrapper (and
+    tests simulating a hang) can replace exactly the part that talks to
+    devices."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -103,28 +140,52 @@ def check_mesh_health(mesh) -> bool:
     return int(out) == n
 
 
-def detect_dropped_workers(mesh) -> Tuple[int, ...]:
+def _device_probe(dev) -> bool:
+    """Tiny transfer+compute against one device; True when it answers."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(jnp.ones(()), dev)
+    return float(x + 1) == 2.0
+
+
+def check_mesh_health(mesh, timeout_s: Optional[float] = None) -> bool:
+    """Failure detection probe: every device contributes 1 to a psum;
+    a healthy N-device mesh returns N everywhere. Raises nothing itself —
+    runtime errors from dead devices propagate to the caller, which
+    should translate them (or a False return) into a dropped set.
+
+    ``timeout_s`` bounds the probe's wall clock (a hung device blocks a
+    collective forever rather than raising): on expiry the mesh is
+    reported unhealthy (False) instead of hanging the detector."""
+    try:
+        return bool(_run_bounded(lambda: _collective_probe(mesh),
+                                 timeout_s))
+    except ProbeTimeout:
+        return False
+
+
+def detect_dropped_workers(
+    mesh, timeout_s: Optional[float] = None
+) -> Tuple[int, ...]:
     """Map an unhealthy mesh to the set of dead workers.
 
     Fast path: the collective ``check_mesh_health`` probe — healthy
     means no per-device work at all. On failure (False, or the
     collective itself raising, which is how a dead chip actually
     surfaces), fall back to probing each device INDIVIDUALLY with a
-    tiny transfer+compute; devices that raise are the dropped set.
+    tiny transfer+compute; devices that raise — or hang past
+    ``timeout_s`` [ISSUE 3 satellite] — are the dropped set.
     Raises if every device fails (nothing to renormalize over)."""
-    import jax
-    import jax.numpy as jnp
-
     try:
-        if check_mesh_health(mesh):
+        if check_mesh_health(mesh, timeout_s=timeout_s):
             return ()
     except Exception:
         pass  # collective died: fall through to per-device probing
     dropped = []
     for w, dev in enumerate(mesh.devices.flat):
         try:
-            x = jax.device_put(jnp.ones(()), dev)
-            if float(x + 1) != 2.0:
+            if not _run_bounded(lambda d=dev: _device_probe(d), timeout_s):
                 dropped.append(w)
         except Exception:
             dropped.append(w)

@@ -100,8 +100,17 @@ def sharded_count_fn(mesh, cap: int, q_bucket: int):
 
 
 def sharded_counts(mesh, base_dev, cap: int, q: np.ndarray,
-                   dtype) -> Tuple[np.ndarray, np.ndarray]:
-    """(less, leq) int64 counts of queries against the placed base run."""
+                   dtype, chaos=None) -> Tuple[np.ndarray, np.ndarray]:
+    """(less, leq) int64 counts of queries against the placed base run.
+
+    ``chaos`` (a ``testing.chaos.FaultInjector``) fires the
+    ``sharded_count`` hook before the device call — a scheduled fault
+    raises here exactly where a dead mesh device would, so the serving
+    index's self-healing retry path is exercised deterministically
+    [ISSUE 3].
+    """
+    if chaos is not None:
+        chaos.fire("sharded_count")
     qb = next_bucket(len(q))
     q_p = np.zeros(qb, dtype=dtype)
     q_p[: len(q)] = q

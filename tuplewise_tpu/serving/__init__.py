@@ -33,11 +33,20 @@ traffic is a *stream* of scored events, so this package adds:
                                  engine and report events/s + latency
                                  percentiles (the ``tuplewise replay``
                                  CLI and the northstar ``serve`` stage).
+* ``recovery``                 — crash-safe snapshots + event-tail WAL
+                                 (``tuplewise serve --recover``), and
+                                 the fault-tolerance layer's typed
+                                 errors: ``EngineClosedError``,
+                                 ``PoisonEventError``,
+                                 ``DeadlineExceededError`` [ISSUE 3].
 """
 
 from tuplewise_tpu.serving.engine import (
     BackpressureError,
+    DeadlineExceededError,
+    EngineClosedError,
     MicroBatchEngine,
+    PoisonEventError,
     ServingConfig,
 )
 from tuplewise_tpu.serving.index import ExactAucIndex
@@ -46,8 +55,11 @@ from tuplewise_tpu.serving.streaming import StreamingIncompleteU
 
 __all__ = [
     "BackpressureError",
+    "DeadlineExceededError",
+    "EngineClosedError",
     "ExactAucIndex",
     "MicroBatchEngine",
+    "PoisonEventError",
     "ServingConfig",
     "StreamingIncompleteU",
     "make_stream",

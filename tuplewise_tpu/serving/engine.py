@@ -25,6 +25,19 @@ Observability: every engine owns a ``MetricsRegistry`` (no process
 globals) with request/batch counters and latency / batch-fill /
 queue-depth histograms; ``stats()`` snapshots everything plus the
 index/streaming state in one JSON-able dict.
+
+Lifecycle hardening [ISSUE 3]: the batcher worker runs under a
+supervisor that restarts it if it dies (``batcher_restarts``);
+``close()`` drains the queue and fails unapplied requests — including
+producers blocked by the "block" policy — with a typed
+``EngineClosedError`` instead of deadlocking; per-request deadlines
+(``ServingConfig.deadline_s``) fail stale requests at dispatch with
+``DeadlineExceededError``; and insert payloads are validated at the
+edge — NaN/inf scores or shape mismatches raise ``PoisonEventError``
+(counted in ``poison_rejects``) before they can reach the exact index.
+Crash-safe recovery (``ServingConfig.snapshot_dir`` / ``recover``)
+write-ahead-logs every admitted insert and snapshots index+reservoir
+state periodically (``serving/recovery.py``).
 """
 
 from __future__ import annotations
@@ -49,6 +62,22 @@ class BackpressureError(RuntimeError):
     """The request was shed by the engine's backpressure policy."""
 
 
+class EngineClosedError(RuntimeError):
+    """The engine shut down before (or while) the request was applied —
+    the typed outcome every queued/blocked producer sees at close()
+    instead of a hang. [ISSUE 3]"""
+
+
+class PoisonEventError(ValueError):
+    """An insert payload failed edge validation (NaN/inf score, shape
+    mismatch) and was rejected before reaching the index. [ISSUE 3]"""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request aged past ``ServingConfig.deadline_s`` in the queue
+    and was failed at dispatch rather than served stale. [ISSUE 3]"""
+
+
 @dataclasses.dataclass(frozen=True)
 class ServingConfig:
     """Knobs of the online service (defaults favor throughput)."""
@@ -66,6 +95,10 @@ class ServingConfig:
     flush_timeout_s: float = 0.002  # batcher drain window
     queue_size: int = 1024         # bounded request queue
     policy: str = "reject"         # reject | drop_oldest | block
+    deadline_s: Optional[float] = None  # fail requests older than this
+    snapshot_dir: Optional[str] = None  # crash-safe snapshots + event WAL
+    snapshot_every: int = 4096     # events between snapshots
+    recover: bool = False          # restore snapshot_dir state on start
     seed: int = 0
 
     def __post_init__(self):
@@ -75,6 +108,13 @@ class ServingConfig:
             raise ValueError(f"max_batch must be >= 1: {self.max_batch}")
         if self.queue_size < 1:
             raise ValueError(f"queue_size must be >= 1: {self.queue_size}")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0: {self.deadline_s}")
+        if self.snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1: {self.snapshot_every}")
+        if self.recover and not self.snapshot_dir:
+            raise ValueError("recover=True needs snapshot_dir")
 
 
 class _Request:
@@ -96,12 +136,13 @@ class MicroBatchEngine:
     """
 
     def __init__(self, config: Optional[ServingConfig] = None,
-                 **overrides):
+                 chaos=None, **overrides):
         if config is None:
             config = ServingConfig(**overrides)
         elif overrides:
             config = dataclasses.replace(config, **overrides)
         self.config = config
+        self.chaos = chaos
         self.metrics = MetricsRegistry()
         # the index records compactions_total / compaction_pause_s into
         # the engine's registry, so stats() carries the pause histogram
@@ -109,6 +150,7 @@ class MicroBatchEngine:
             window=config.window, compact_every=config.compact_every,
             engine=config.engine, shards=config.mesh_shards,
             bg_compact=config.bg_compact, metrics=self.metrics,
+            chaos=chaos,
         ) if config.kernel == "auc" else None
         self.streaming = StreamingIncompleteU(
             kernel=config.kernel, budget=config.budget,
@@ -122,6 +164,9 @@ class MicroBatchEngine:
         self._c_batches = m.counter("batches_total")
         self._c_events = m.counter("events_total")
         self._c_pairs = m.counter("incomplete_pairs_total")
+        self._c_poison = m.counter("poison_rejects")
+        self._c_deadline = m.counter("deadline_expired_total")
+        self._c_batcher_restarts = m.counter("batcher_restarts")
         self._h_latency = m.histogram("request_latency_s")
         # per-event insert latency (enqueue -> applied), the number the
         # compaction-pause work is judged by in bench.py --streaming
@@ -135,8 +180,20 @@ class MicroBatchEngine:
             maxsize=config.queue_size)
         self._lock = threading.Lock()   # guards estimator state
         self._closed = False
+        # crash-safe recovery [ISSUE 3]: restore BEFORE the worker
+        # starts, so recovered state is in place for the first request
+        self._recovery = None
+        if config.snapshot_dir:
+            from tuplewise_tpu.serving.recovery import RecoveryManager
+
+            self._recovery = RecoveryManager(
+                config.snapshot_dir, snapshot_every=config.snapshot_every)
+            if config.recover:
+                self._recovery.recover(self)
+            else:
+                self._recovery.start_fresh()
         self._worker = threading.Thread(
-            target=self._run, name="tuplewise-batcher", daemon=True)
+            target=self._supervise, name="tuplewise-batcher", daemon=True)
         self._worker.start()
 
     # ------------------------------------------------------------------ #
@@ -153,12 +210,9 @@ class MicroBatchEngine:
         if kind not in _KINDS:
             raise ValueError(f"unknown request kind {kind!r}")
         if self._closed:
-            raise RuntimeError("engine is closed")
+            raise EngineClosedError("engine is closed")
         if kind == "insert":
-            scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
-            labels = np.atleast_1d(np.asarray(labels))
-            if scores.shape != labels.shape:
-                raise ValueError("insert: scores/labels shape mismatch")
+            scores, labels = self._validate_insert(scores, labels)
         elif kind == "score":
             scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
         req = _Request(kind, scores, labels)
@@ -166,6 +220,11 @@ class MicroBatchEngine:
         policy = self.config.policy
         if policy == "block":
             self._q.put(req)
+            if self._closed:
+                # close() raced our enqueue: its drain may already have
+                # run, so drain (and fail) ourselves — nothing may be
+                # left to dangle in a queue no worker will ever read
+                self._fail_queued()
         else:
             try:
                 self._q.put_nowait(req)
@@ -187,6 +246,27 @@ class MicroBatchEngine:
                 self._q.put(req)
         return req.future
 
+    def _validate_insert(self, scores, labels):
+        """Edge validation [ISSUE 3]: poison events — NaN/inf scores,
+        non-finite labels, shape mismatches — must fail the SUBMITTER
+        (typed, counted) rather than ride a micro-batch into the index
+        and fail every coalesced neighbor."""
+        scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
+        labels = np.atleast_1d(np.asarray(labels))
+        if scores.shape != labels.shape:
+            self._c_poison.inc()
+            raise PoisonEventError(
+                f"insert: scores/labels shape mismatch: {scores.shape} "
+                f"vs {labels.shape}")
+        if len(scores) and not np.all(np.isfinite(scores)):
+            self._c_poison.inc()
+            raise PoisonEventError("insert: non-finite score(s) rejected")
+        if labels.dtype.kind == "f" and len(labels) \
+                and not np.all(np.isfinite(labels)):
+            self._c_poison.inc()
+            raise PoisonEventError("insert: non-finite label(s) rejected")
+        return scores, labels
+
     def insert(self, scores, labels) -> Future:
         return self.submit("insert", scores, labels)
 
@@ -203,15 +283,36 @@ class MicroBatchEngine:
     # ------------------------------------------------------------------ #
     # batcher side                                                       #
     # ------------------------------------------------------------------ #
-    def _run(self) -> None:
+    def _supervise(self) -> None:
+        """Batcher supervisor [ISSUE 3]: an unexpected escape from the
+        worker loop (chaos fault, estimator bug) must not leave every
+        future — and every "block"-policy producer — hanging on a dead
+        thread. Restart the loop in place and count it; on close, just
+        exit (close() drains)."""
         while True:
             try:
-                first = self._q.get(timeout=0.1)
+                self._run()
+                return
+            except BaseException:
+                if self._closed:
+                    return
+                self._c_batcher_restarts.inc()
+
+    def _run(self) -> None:
+        while True:
+            if self.chaos is not None:
+                # fired between batches: no futures are in flight here,
+                # so an injected crash exercises the supervisor restart
+                # without stranding requests
+                self.chaos.fire("batcher")
+            try:
+                first = self._q.get(timeout=0.05)
             except queue.Empty:
                 if self._closed:
                     return
                 continue
-            if first is None:       # shutdown sentinel
+            if first is None or self._closed:
+                self._fail_queued(first)
                 return
             self._h_depth.observe(self._q.qsize() + 1)
             batch = [first]
@@ -226,11 +327,33 @@ class MicroBatchEngine:
                     break
                 if nxt is None:
                     self._dispatch(batch)
+                    self._fail_queued()
                     return
                 batch.append(nxt)
             self._dispatch(batch)
 
+    def _fail_queued(self, first: Optional[_Request] = None) -> None:
+        """Drain the queue, failing every unapplied request with
+        EngineClosedError. Draining is what UNBLOCKS producers stuck in
+        a full-queue put under the "block" policy — their requests then
+        land here (or in close()'s final drain / their own post-put
+        check) and fail typed instead of hanging."""
+        exc = EngineClosedError(
+            "engine closed before the request was applied")
+        r = first
+        while True:
+            if r is not None and not r.future.done():
+                r.future.set_exception(exc)
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+
     def _dispatch(self, batch: List[_Request]) -> None:
+        if self.config.deadline_s is not None:
+            batch = self._expire(batch)
+            if not batch:
+                return
         self._c_batches.inc()
         self._h_fill.observe(len(batch) / self.config.max_batch)
         for kind, run in self._runs(batch):
@@ -253,6 +376,25 @@ class MicroBatchEngine:
                 if kind == "insert":
                     self._h_insert_lat.observe(now - r.t_enqueue)
 
+    def _expire(self, batch: List[_Request]) -> List[_Request]:
+        """Deadline enforcement at dispatch [ISSUE 3]: a request that
+        aged past ``deadline_s`` in the queue fails typed — serving it
+        would return a stale answer late AND delay everything behind
+        it."""
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for r in batch:
+            if now - r.t_enqueue > self.config.deadline_s:
+                self._c_deadline.inc()
+                if not r.future.done():
+                    r.future.set_exception(DeadlineExceededError(
+                        f"request expired after {now - r.t_enqueue:.3f}s "
+                        f"in queue (deadline_s="
+                        f"{self.config.deadline_s})"))
+            else:
+                live.append(r)
+        return live
+
     @staticmethod
     def _runs(batch: List[_Request]) -> List[Tuple[str, List[_Request]]]:
         """Split a batch into maximal consecutive same-kind runs —
@@ -269,9 +411,16 @@ class MicroBatchEngine:
         scores = np.concatenate([r.scores for r in run])
         labels = np.concatenate([r.labels for r in run]).astype(bool)
         with self._lock:
+            if self._recovery is not None:
+                # write-ahead: the WAL records the batch BEFORE it is
+                # applied, so a crash mid-apply replays it on recovery
+                # (an admitted event is never lost)
+                self._recovery.record(scores, labels)
             if self.index is not None:
                 self.index.insert_batch(scores, labels)
             spent = self.streaming.extend(scores, labels)
+            if self._recovery is not None:
+                self._recovery.maybe_snapshot(self)
         self._c_events.inc(len(scores))
         self._c_pairs.inc(spent)
         for r in run:
@@ -305,11 +454,23 @@ class MicroBatchEngine:
         return out
 
     def close(self, timeout: float = 10.0) -> None:
+        """Shut down without stranding anyone [ISSUE 3]: the worker
+        drains the queue (which unblocks "block"-policy producers
+        waiting for capacity) and every unapplied request fails with
+        ``EngineClosedError``; a final drain here catches requests that
+        raced the shutdown. Never blocks on a full queue — the old
+        sentinel put could deadlock close() itself."""
         if self._closed:
             return
         self._closed = True
-        self._q.put(None)
+        try:
+            self._q.put_nowait(None)    # wake the worker fast; the
+        except queue.Full:              # 0.05 s poll catches it anyway
+            pass
         self._worker.join(timeout=timeout)
+        self._fail_queued()
+        if self._recovery is not None:
+            self._recovery.checkpoint_and_close(self)
         if self.index is not None:
             self.index.close(timeout=timeout)
 

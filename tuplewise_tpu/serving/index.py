@@ -56,6 +56,19 @@ thread — compaction (foreground or background) never touches it, so
 prefix AUCs are bit-identical to the synchronous index under any
 interleaving.
 
+**Fault tolerance** [ISSUE 3]: the host is authoritative for the base
+runs — the device shards are a pure cache — so a dead/hung mesh device
+is survivable: a failed sharded count probes the mesh
+(``parallel.faults``), re-places the runs over the surviving devices,
+and retries with bounded backoff (``reshard_events`` /
+``recovery_time_s`` metrics; bit-identical counts by additivity). A
+crashed background build rolls back its snapshot claim (the statistic
+is untouched — compaction never writes wins2) and a watchdog restarts
+the compactor thread (``bg_compactor_restarts``), falling back to
+synchronous compaction for that trigger. Chaos schedules
+(``testing.chaos.FaultInjector``) drive both paths deterministically
+in tests and CI.
+
 Scores must be finite (the +inf bucket padding relies on it).
 """
 
@@ -182,12 +195,27 @@ class ExactAucIndex:
         ``compactions_total`` / ``compaction_pause_s`` into (the engine
         passes its own so pauses surface in ``stats()``); None = a
         private registry.
+      chaos: a ``testing.chaos.FaultInjector`` threaded through the
+        sharded-count and compactor-build hook points (None = no
+        hooks). [ISSUE 3]
+      shard_retries: bounded retries of a sharded count query after a
+        device failure; each retry is preceded by a self-heal — probe
+        the mesh, re-place the host-authoritative base runs over the
+        surviving devices — and exponential backoff. Exactness is
+        preserved because the host always holds the merged runs; the
+        device shards are a pure cache.
+      retry_backoff_s: base of the bounded exponential backoff between
+        sharded-count retries.
+      probe_timeout_s: wall-clock bound on the mesh health probe during
+        self-heal (a hung device must not hang the detector).
     """
 
     def __init__(self, window: Optional[int] = None,
                  compact_every: int = 512, engine: str = "jax",
                  shards: Optional[int] = None, mesh=None,
-                 bg_compact: bool = False, metrics=None):
+                 bg_compact: bool = False, metrics=None, chaos=None,
+                 shard_retries: int = 3, retry_backoff_s: float = 0.02,
+                 probe_timeout_s: float = 5.0):
         if engine not in ("jax", "numpy"):
             raise ValueError(f"engine must be 'jax' or 'numpy': {engine!r}")
         if window is not None and window < 2:
@@ -205,6 +233,10 @@ class ExactAucIndex:
         self.engine = engine
         self.shards = shards
         self.bg_compact = bg_compact
+        self.chaos = chaos
+        self.shard_retries = shard_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.probe_timeout_s = probe_timeout_s
         self.dtype = np.float32 if engine == "jax" else np.float64
         self._mesh = mesh
         if shards is not None and mesh is None:
@@ -223,12 +255,18 @@ class ExactAucIndex:
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._c_compactions = self.metrics.counter("compactions_total")
         self._h_pause = self.metrics.histogram("compaction_pause_s")
+        # fault-tolerance observability [ISSUE 3]
+        self._c_reshard = self.metrics.counter("reshard_events")
+        self._c_retries = self.metrics.counter("shard_retries_total")
+        self._h_recovery = self.metrics.histogram("recovery_time_s")
+        self._c_bg_restarts = self.metrics.counter("bg_compactor_restarts")
         # one re-entrant lock guards ALL container structure; the
         # condition signals build completion (compact() drains on it).
         # Synchronous mode takes the same (uncontended) lock — one code
         # path, negligible cost.
         self._cv = threading.Condition(threading.RLock())
         self._closed = False
+        self.last_compactor_error = None   # repr of a crashed build
         self._bg_test_hook = None    # tests: called at build start
         if bg_compact:
             self._jobs: "queue.Queue[Optional[_ClassSide]]" = queue.Queue()
@@ -247,10 +285,7 @@ class ExactAucIndex:
             z = np.zeros(len(q), dtype=np.int64)
             return z, z
         if self.shards is not None:
-            from tuplewise_tpu.parallel.sharded_counts import sharded_counts
-
-            return sharded_counts(
-                self._mesh, side.base_dev, side.cap, q, self.dtype)
+            return self._sharded_base_counts(side, q)
         if self.engine == "jax":
             bb = _next_bucket(len(side.base))
             qb = _next_bucket(len(q))
@@ -264,6 +299,63 @@ class ExactAucIndex:
         less = np.searchsorted(side.base, q, side="left")
         leq = np.searchsorted(side.base, q, side="right")
         return less.astype(np.int64), leq.astype(np.int64)
+
+    def _sharded_base_counts(
+        self, side: _ClassSide, q: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Sharded counts with bounded self-healing retries [ISSUE 3].
+
+        A device failure surfaces as the count call raising. The host
+        is authoritative for the merged base runs, so recovery is:
+        probe which workers are dead, rebuild the mesh over the
+        survivors, re-place BOTH sides' base runs, back off, retry —
+        the re-placed counts are bit-identical (counting is additive
+        over any partition), so a healed query returns exactly what the
+        healthy mesh would have.
+        """
+        from tuplewise_tpu.parallel.sharded_counts import sharded_counts
+
+        attempt = 0
+        while True:
+            try:
+                return sharded_counts(self._mesh, side.base_dev, side.cap,
+                                      q, self.dtype, chaos=self.chaos)
+            except Exception:
+                attempt += 1
+                if attempt > self.shard_retries:
+                    raise
+                self._c_retries.inc()
+                self._heal_mesh(attempt)
+
+    def _heal_mesh(self, attempt: int) -> None:
+        """Probe -> reshard over survivors -> re-place -> back off."""
+        from tuplewise_tpu.parallel.faults import detect_dropped_workers
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        t0 = time.perf_counter()
+        dropped = self.chaos.take_dropped() if self.chaos is not None \
+            else None
+        if dropped is None:
+            try:
+                dropped = detect_dropped_workers(
+                    self._mesh, timeout_s=self.probe_timeout_s)
+            except Exception:
+                # the detector itself failed (all devices unreachable,
+                # or the probe machinery died): retry on the same mesh
+                # — if the fault was transient the retry succeeds, else
+                # the retry bound surfaces the original error
+                dropped = ()
+        if dropped:
+            alive = [d for i, d in enumerate(self._mesh.devices.flat)
+                     if i not in set(dropped)]
+            self._mesh = make_mesh(devices=alive)
+            self.shards = len(alive)
+        # re-place from the host-authoritative runs (pure cache rebuild)
+        self._place(self._pos)
+        self._place(self._neg)
+        self._c_reshard.inc()
+        self._h_recovery.observe(time.perf_counter() - t0)
+        time.sleep(min(self.retry_backoff_s * (2 ** (attempt - 1)), 1.0))
 
     def _counts(self, side: _ClassSide,
                 q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -374,31 +466,62 @@ class ExactAucIndex:
         self.n_evicted += count
 
     def _maybe_compact(self) -> None:
+        bg_ok = self._ensure_compactor() if self.bg_compact else False
         for side in (self._pos, self._neg):
             buf_pending, tomb_pending = side.pending
             if (buf_pending >= self.compact_every
                     or tomb_pending >= self.compact_every):
-                if self.bg_compact:
+                if self.bg_compact and bg_ok:
                     self._submit_compact(side)
-                else:
+                elif not side.building:
+                    # watchdog fallback [ISSUE 3]: the compactor thread
+                    # is dead (crashed build) — compact synchronously
+                    # rather than let the buffer grow unboundedly while
+                    # the restarted thread warms up. A side mid-build
+                    # is left for the restarted worker (its queued job
+                    # owns the snapshot prefixes).
                     self._compact_side(side)
+
+    def _ensure_compactor(self) -> bool:
+        """Watchdog (caller holds the lock): True when the background
+        compactor thread is alive. A dead worker — a crashed build —
+        is restarted (``bg_compactor_restarts``) and False is returned
+        so the caller compacts synchronously this once; jobs still
+        queued are picked up by the fresh thread."""
+        if self._compactor.is_alive():
+            return True
+        if not self._closed:
+            self._c_bg_restarts.inc()
+            self._compactor = threading.Thread(
+                target=self._compact_worker, name="tuplewise-compactor",
+                daemon=True)
+            self._compactor.start()
+        return False
+
+    def _drain_builds(self, timeout: float, what: str) -> None:
+        """Wait until no build is queued or in flight, restarting a
+        dead compactor along the way (a crashed worker must not turn a
+        drain into a hang)."""
+        deadline = time.monotonic() + timeout
+        while self._pos.building or self._neg.building:
+            if self.bg_compact:
+                self._ensure_compactor()
+            if (not self._cv.wait(timeout=0.25)
+                    and time.monotonic() >= deadline):
+                raise TimeoutError(what)
 
     def wait_idle(self, timeout: float = 30.0) -> None:
         """Block until no background build is queued or in flight —
         after this, pause/compaction metrics are settled (measurement
         code calls it so records don't depend on compactor timing)."""
         with self._cv:
-            while self._pos.building or self._neg.building:
-                if not self._cv.wait(timeout=timeout):
-                    raise TimeoutError("background compaction stuck")
+            self._drain_builds(timeout, "background compaction stuck")
 
     def compact(self) -> None:
         """Force both sides into a single sorted base run (drains any
         in-flight background builds first)."""
         with self._cv:
-            while self._pos.building or self._neg.building:
-                if not self._cv.wait(timeout=30.0):
-                    raise TimeoutError("background compaction stuck")
+            self._drain_builds(30.0, "background compaction stuck")
             for side in (self._pos, self._neg):
                 if side.buf or side.tomb:
                     self._compact_side(side)
@@ -475,42 +598,64 @@ class ExactAucIndex:
             side = self._jobs.get()
             if side is None:
                 return
-            if self._bg_test_hook is not None:
-                self._bg_test_hook(side)
-            with self._cv:
-                base = side.base
-                buf_snap = list(side.buf[: side.snap_buf])
-                tomb_snap = list(side.tomb[: side.snap_tomb])
-            # the expensive part — merge + device placement — runs with
-            # the lock RELEASED; inserts keep landing in the buffer
-            merged = self._merge(base, buf_snap, tomb_snap,
-                                 on_thread=False)
-            if self.shards is not None and len(merged):
-                from tuplewise_tpu.parallel.sharded_counts import place_base
+            try:
+                self._build_and_swap(side)
+            except BaseException as e:
+                # Roll back the snapshot claim so nothing is lost: the
+                # buffer/tombstones still hold every value (prefixes
+                # are only trimmed at the swap) and wins2 was never
+                # touched, so the statistic is unaffected — the next
+                # trigger simply re-compacts. Then die (quietly — the
+                # error is kept in ``last_compactor_error`` rather than
+                # sprayed through the thread excepthook): the watchdog
+                # (`_ensure_compactor`) restarts the thread and counts
+                # the restart. [ISSUE 3]
+                with self._cv:
+                    side.snap_buf = side.snap_tomb = 0
+                    side.building = False
+                    self.last_compactor_error = repr(e)
+                    self._cv.notify_all()
+                return
 
-                base_dev, cap = place_base(self._mesh, merged, self.dtype)
-            else:
-                base_dev, cap = None, 0
-            with self._cv:
-                t0 = time.perf_counter()
-                side.base = merged
-                side.base_dev, side.cap = base_dev, cap
-                del side.buf[: side.snap_buf]
-                del side.tomb[: side.snap_tomb]
-                side.snap_buf = side.snap_tomb = 0
-                side.building = False
-                self.n_compactions += 1
-                self._c_compactions.inc()
-                # the swap is the ONLY pause the hot path can observe
-                self._h_pause.observe(time.perf_counter() - t0)
-                # keep draining if the buffer outgrew the threshold
-                # while this build ran
-                buf_pending, tomb_pending = side.pending
-                if (not self._closed
-                        and (buf_pending >= self.compact_every
-                             or tomb_pending >= self.compact_every)):
-                    self._submit_compact(side)
-                self._cv.notify_all()
+    def _build_and_swap(self, side: _ClassSide) -> None:
+        if self._bg_test_hook is not None:
+            self._bg_test_hook(side)
+        if self.chaos is not None:
+            self.chaos.fire("compactor_build")
+        with self._cv:
+            base = side.base
+            buf_snap = list(side.buf[: side.snap_buf])
+            tomb_snap = list(side.tomb[: side.snap_tomb])
+        # the expensive part — merge + device placement — runs with
+        # the lock RELEASED; inserts keep landing in the buffer
+        merged = self._merge(base, buf_snap, tomb_snap,
+                             on_thread=False)
+        if self.shards is not None and len(merged):
+            from tuplewise_tpu.parallel.sharded_counts import place_base
+
+            base_dev, cap = place_base(self._mesh, merged, self.dtype)
+        else:
+            base_dev, cap = None, 0
+        with self._cv:
+            t0 = time.perf_counter()
+            side.base = merged
+            side.base_dev, side.cap = base_dev, cap
+            del side.buf[: side.snap_buf]
+            del side.tomb[: side.snap_tomb]
+            side.snap_buf = side.snap_tomb = 0
+            side.building = False
+            self.n_compactions += 1
+            self._c_compactions.inc()
+            # the swap is the ONLY pause the hot path can observe
+            self._h_pause.observe(time.perf_counter() - t0)
+            # keep draining if the buffer outgrew the threshold
+            # while this build ran
+            buf_pending, tomb_pending = side.pending
+            if (not self._closed
+                    and (buf_pending >= self.compact_every
+                         or tomb_pending >= self.compact_every)):
+                self._submit_compact(side)
+            self._cv.notify_all()
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop the background compactor (no-op in synchronous mode)."""
@@ -585,4 +730,5 @@ class ExactAucIndex:
                 "window": self.window,
                 "shards": self.shards,
                 "bg_compact": self.bg_compact,
+                "last_compactor_error": self.last_compactor_error,
             }

@@ -1,0 +1,251 @@
+"""Crash-safe serving recovery: atomic snapshots + a replayable event
+tail [ISSUE 3].
+
+The exact index is pure deterministic state: wins2 and the containers
+are a function of the admitted event sequence, independent of batching
+(that independence is the index's core contract). So crash safety
+decomposes into two durable artifacts:
+
+* **Snapshot** — a single-file ``.npz`` of the full estimator state
+  (base runs, buffers, tombstones, arrival log, wins2 as a decimal
+  string — it is an unbounded Python int — plus the incomplete-U sums,
+  reservoirs, and host RNG state), written through
+  ``utils.checkpoint.save_checkpoint`` (fsync'd temp + atomic rename:
+  a snapshot either exists completely or not at all).
+* **WAL** — an append-only JSONL write-ahead log of admitted insert
+  batches, flushed to the OS before the batch is applied. A SIGKILL
+  cannot lose an admitted event: file data written via ``write()``
+  survives process death. Each entry carries its absolute event
+  sequence number, so replay after a snapshot at seq S skips entries
+  below S — truncation racing a crash is harmless.
+
+Recovery = restore the snapshot, replay the tail. Both operations are
+bit-exact: wins2 round-trips through its decimal string, scores
+round-trip through JSON's shortest-repr floats, and replaying the tail
+runs the *same* ``insert_batch`` integer-count updates the live path
+runs — so every post-recovery prefix AUC matches the uninterrupted run
+bit-for-bit (``tests/test_chaos_serving.py`` asserts it, including
+across a real SIGKILL).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from tuplewise_tpu.utils.checkpoint import (
+    check_config, load_checkpoint, save_checkpoint,
+)
+
+SNAPSHOT_FILE = "snapshot.npz"
+WAL_FILE = "events.wal"
+
+
+class EventLog:
+    """Append-only JSONL WAL of admitted insert batches."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+
+    def append(self, seq: int, scores: np.ndarray,
+               labels: np.ndarray) -> None:
+        rec = {"seq": int(seq),
+               "s": [float(x) for x in scores],
+               "l": [int(bool(x)) for x in labels]}
+        self._f.write(json.dumps(rec) + "\n")
+        # flush past the process boundary: survives SIGKILL (os.fsync
+        # would additionally survive power loss, at per-batch cost —
+        # the snapshot path IS fsync'd, so a machine crash loses at
+        # most the tail since the last snapshot)
+        self._f.flush()
+
+    def truncate(self) -> None:
+        """Start a fresh log (called right after a snapshot lands)."""
+        self._f.close()
+        self._f = open(self.path, "w", encoding="utf-8")
+
+    def close(self) -> None:
+        self._f.close()
+
+    @staticmethod
+    def replay(path: str) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
+        """Yield (seq, scores, labels) entries; a torn final line (the
+        crash interrupted the write) ends the replay cleanly."""
+        if not os.path.exists(path):
+            return
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    return
+                yield (int(rec["seq"]),
+                       np.asarray(rec["s"], dtype=np.float64),
+                       np.asarray(rec["l"], dtype=bool))
+
+
+def _compat_config(config) -> dict:
+    """The config keys a snapshot must agree on to be resumable —
+    anything that changes what the recovered state MEANS."""
+    return {
+        "kernel": config.kernel, "budget": config.budget,
+        "reservoir": config.reservoir, "design": config.design,
+        "window": config.window, "engine": config.engine,
+        "seed": config.seed,
+    }
+
+
+def save_snapshot(directory: str, *, seq: int, engine) -> None:
+    """Capture the engine's full estimator state atomically."""
+    extra = {}
+    cfg = dict(_compat_config(engine.config))
+    idx = engine.index
+    if idx is not None:
+        with idx._cv:
+            for name, side in (("pos", idx._pos), ("neg", idx._neg)):
+                extra[f"{name}_base"] = np.asarray(side.base,
+                                                   dtype=idx.dtype)
+                extra[f"{name}_buf"] = np.asarray(side.buf,
+                                                  dtype=idx.dtype)
+                extra[f"{name}_tomb"] = np.asarray(side.tomb,
+                                                   dtype=idx.dtype)
+            extra["log_scores"] = np.asarray(
+                [v for v, _ in idx._log], dtype=idx.dtype)
+            extra["log_labels"] = np.asarray(
+                [p for _, p in idx._log], dtype=bool)
+            # wins2 is an unbounded Python int: a decimal string is the
+            # only exact serialization
+            cfg["wins2"] = str(idx._wins2)
+            cfg["n_compactions"] = idx.n_compactions
+            cfg["n_evicted"] = idx.n_evicted
+    st = engine.streaming
+    extra["stream_sums"] = np.asarray([st._sum_h, st._sum_h2],
+                                      dtype=np.float64)
+    extra["stream_counts"] = np.asarray(
+        [st._n_terms, st.n_arrivals], dtype=np.int64)
+    for name, res in (("rpos", st._pos), ("rneg", st._neg)):
+        extra[f"{name}_items"] = res.items[: res.size].copy()
+        extra[f"{name}_meta"] = np.asarray([res.size, res.seen],
+                                           dtype=np.int64)
+    cfg["rng_state"] = st._rng.bit_generator.state
+    save_checkpoint(os.path.join(directory, SNAPSHOT_FILE),
+                    step=seq, extra=extra, config=cfg)
+
+
+def restore_snapshot(directory: str, engine) -> Optional[int]:
+    """Restore a snapshot into a freshly-constructed engine; returns
+    the snapshot's event seq, or None when no snapshot exists. Raises
+    if the stored config is incompatible with the engine's (resuming a
+    different experiment would silently corrupt the statistic)."""
+    ck = load_checkpoint(os.path.join(directory, SNAPSHOT_FILE))
+    if ck is None:
+        return None
+    cfg, extra = ck["config"], ck["extra"]
+    check_config(
+        {k: cfg.get(k) for k in _compat_config(engine.config)},
+        _compat_config(engine.config))
+    idx = engine.index
+    if idx is not None and "pos_base" in extra:
+        with idx._cv:
+            for name, side in (("pos", idx._pos), ("neg", idx._neg)):
+                side.base = extra[f"{name}_base"].astype(idx.dtype)
+                side.buf = extra[f"{name}_buf"].astype(
+                    idx.dtype).tolist()
+                side.tomb = extra[f"{name}_tomb"].astype(
+                    idx.dtype).tolist()
+            idx._log = collections.deque(zip(
+                extra["log_scores"].astype(idx.dtype).tolist(),
+                [bool(b) for b in extra["log_labels"]]))
+            idx._wins2 = int(cfg["wins2"])
+            idx.n_compactions = int(cfg["n_compactions"])
+            idx.n_evicted = int(cfg["n_evicted"])
+            idx._place(idx._pos)
+            idx._place(idx._neg)
+    st = engine.streaming
+    st._sum_h, st._sum_h2 = (float(x) for x in extra["stream_sums"])
+    st._n_terms, st.n_arrivals = (int(x) for x in extra["stream_counts"])
+    for name, res in (("rpos", st._pos), ("rneg", st._neg)):
+        size, seen = (int(x) for x in extra[f"{name}_meta"])
+        res.items[:size] = extra[f"{name}_items"]
+        res.size, res.seen = size, seen
+    st._rng.bit_generator.state = cfg["rng_state"]
+    return int(ck["step"])
+
+
+class RecoveryManager:
+    """Owns a recovery directory: the WAL, the snapshot cadence, and
+    the recover-on-start protocol. One per engine; all calls arrive on
+    the batcher thread (or before the worker starts), so no lock."""
+
+    def __init__(self, directory: str, snapshot_every: int = 4096):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.snapshot_every = snapshot_every
+        self._wal: Optional[EventLog] = None
+        self._seq = 0
+        self._since_snapshot = 0
+
+    # ------------------------------------------------------------------ #
+    def start_fresh(self) -> None:
+        """A non-recovering start owns the directory: stale state from
+        a previous run must not leak into a later --recover."""
+        snap = os.path.join(self.directory, SNAPSHOT_FILE)
+        if os.path.exists(snap):
+            os.unlink(snap)
+        self._wal = EventLog(os.path.join(self.directory, WAL_FILE))
+        self._wal.truncate()
+
+    def recover(self, engine) -> int:
+        """Snapshot + tail replay; returns the recovered event seq."""
+        seq = restore_snapshot(self.directory, engine) or 0
+        for s0, scores, labels in EventLog.replay(
+                os.path.join(self.directory, WAL_FILE)):
+            if s0 < seq:
+                continue    # already inside the snapshot
+            if engine.index is not None:
+                engine.index.insert_batch(scores, labels)
+            engine.streaming.extend(scores, labels)
+            seq = s0 + len(scores)
+        self._seq = seq
+        self._wal = EventLog(os.path.join(self.directory, WAL_FILE))
+        return seq
+
+    # ------------------------------------------------------------------ #
+    def record(self, scores: np.ndarray, labels: np.ndarray) -> None:
+        self._wal.append(self._seq, scores, labels)
+        self._seq += len(scores)
+        self._since_snapshot += len(scores)
+
+    def maybe_snapshot(self, engine) -> None:
+        if self._since_snapshot >= self.snapshot_every:
+            self.snapshot(engine)
+
+    def snapshot(self, engine) -> None:
+        save_snapshot(self.directory, seq=self._seq, engine=engine)
+        # safe to prune only AFTER the snapshot atomically landed; a
+        # crash in between leaves WAL entries below seq, which replay
+        # skips
+        self._wal.truncate()
+        self._since_snapshot = 0
+
+    def checkpoint_and_close(self, engine) -> None:
+        """Graceful shutdown: one final snapshot so restart is
+        tail-free, then release the WAL handle."""
+        if self._wal is None:
+            return
+        if self._since_snapshot:
+            self.snapshot(engine)
+        self._wal.close()
+        self._wal = None
+
+    @property
+    def seq(self) -> int:
+        return self._seq

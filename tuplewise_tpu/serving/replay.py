@@ -17,7 +17,7 @@ from typing import Optional
 import numpy as np
 
 from tuplewise_tpu.serving.engine import (
-    BackpressureError, MicroBatchEngine, ServingConfig,
+    BackpressureError, MicroBatchEngine, PoisonEventError, ServingConfig,
 )
 
 
@@ -34,7 +34,8 @@ def make_stream(n_events: int, pos_frac: float = 0.5,
 def replay(scores, labels, config: Optional[ServingConfig] = None,
            score_every: int = 0, query_every: int = 0,
            chunk: int = 1, warmup: bool = False,
-           max_inflight: Optional[int] = None, **overrides) -> dict:
+           max_inflight: Optional[int] = None, chaos=None,
+           **overrides) -> dict:
     """Drive the engine with one request per event (or per ``chunk``
     events) and return the measurement record.
 
@@ -54,25 +55,49 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     the bucket ladder, and a cold replay pays those one-time XLA
     compilations inside the timed window (a long-lived service never
     sees them again).
+
+    ``chaos`` [ISSUE 3]: a ``testing.chaos.FaultInjector`` (or a spec
+    accepted by ``FaultInjector.from_spec``) threaded through the
+    engine's hook points; its ``poison`` schedule corrupts the stream
+    at the scheduled event positions before submission (the engine's
+    edge validation rejects them — that is the property under test).
+    The record then carries a ``faults`` block with the recovery
+    counters, and the oracle-parity guardrail is computed over the
+    ADMITTED events only. Warmup runs stay chaos-free (an injector is
+    single-shot state).
     """
     scores = np.asarray(scores, dtype=np.float64).ravel()
     labels = np.asarray(labels).ravel().astype(bool)
     n = len(scores)
     cfg = config or ServingConfig(**overrides)
+    injector = None
+    if chaos is not None:
+        from tuplewise_tpu.testing.chaos import FaultInjector
+
+        injector = FaultInjector.from_spec(chaos)
     if warmup:
         replay(scores, labels, config=cfg, score_every=score_every,
                query_every=query_every, chunk=chunk, warmup=False,
                max_inflight=max_inflight)
     rejected = 0
+    poison_rejected = 0
+    admitted = np.ones(n, dtype=bool)
     futures = []
-    with MicroBatchEngine(cfg) as eng:
+    with MicroBatchEngine(cfg, chaos=injector) as eng:
         t0 = time.perf_counter()
         for i in range(0, n, chunk):
             j = min(i + chunk, n)
+            sub = scores[i:j]
+            if injector is not None:
+                sub, _ = injector.poison_batch(i, sub)
             try:
-                futures.append(eng.insert(scores[i:j], labels[i:j]))
+                futures.append(eng.insert(sub, labels[i:j]))
+            except PoisonEventError:
+                poison_rejected += j - i
+                admitted[i:j] = False
             except BackpressureError:
                 rejected += j - i
+                admitted[i:j] = False
             if max_inflight and len(futures) >= max_inflight:
                 try:
                     futures[len(futures) - max_inflight].result(timeout=60.0)
@@ -116,6 +141,7 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
         "n_events": n,
         "events_applied": int(applied),
         "events_rejected": int(rejected),
+        "events_poison_rejected": int(poison_rejected),
         "requests_dropped": int(dropped),
         "wall_s": wall,
         "events_per_s": applied / wall if wall > 0 else None,
@@ -147,13 +173,35 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             "mesh_shards": cfg.mesh_shards, "bg_compact": cfg.bg_compact,
         },
     }
+    if injector is not None:
+        # the recovery counters an operator greps for after a chaos
+        # run — the same numbers `tuplewise serve`'s exit summary and
+        # the CI chaos smoke assert on
+        def _c(name):
+            return stats["metrics"].get(name, {}).get("value", 0)
+
+        rec["faults"] = {
+            "reshard_events": _c("reshard_events"),
+            "shard_retries_total": _c("shard_retries_total"),
+            "bg_compactor_restarts": _c("bg_compactor_restarts"),
+            "batcher_restarts": _c("batcher_restarts"),
+            "poison_rejects": _c("poison_rejects"),
+            "deadline_expired_total": _c("deadline_expired_total"),
+            "chaos": injector.snapshot(),
+        }
+        rec["n_admitted"] = int(admitted.sum())
+        rec["shed_events"] = np.nonzero(~admitted)[0].tolist()
+
     # oracle parity of the final exact estimate (windowed: oracle over
-    # the retained suffix) — cheap at replay scale, priceless as a
+    # the retained suffix; chaos: over the ADMITTED events — the index
+    # never saw the shed ones) — cheap at replay scale, priceless as a
     # guardrail on every benchmark run
-    if cfg.kernel == "auc" and rejected == 0 and rec["auc_exact"] is not None:
+    if (cfg.kernel == "auc" and rejected == 0 and dropped == 0
+            and rec["auc_exact"] is not None):
+        adm_s, adm_l = scores[admitted], labels[admitted]
         w = cfg.window
-        tail_s = scores if w is None else scores[-w:]
-        tail_l = labels if w is None else labels[-w:]
+        tail_s = adm_s if w is None else adm_s[-w:]
+        tail_l = adm_l if w is None else adm_l[-w:]
         from tuplewise_tpu.models.metrics import auc_score
 
         rec["auc_oracle"] = auc_score(

@@ -1,0 +1,14 @@
+"""Test-support instrumentation shipped with the library [ISSUE 3].
+
+Production code imports nothing from here unless a chaos injector is
+explicitly passed in; the serving stack's fault hooks are no-ops when
+no injector is attached, so this package costs the hot path nothing.
+"""
+
+from tuplewise_tpu.testing.chaos import (
+    FaultInjector,
+    InjectedDeviceError,
+    InjectedFault,
+)
+
+__all__ = ["FaultInjector", "InjectedDeviceError", "InjectedFault"]

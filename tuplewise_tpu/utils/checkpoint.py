@@ -51,7 +51,22 @@ def save_checkpoint(
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **blob)
+            # fsync BEFORE the rename: os.replace makes the new name
+            # atomic against a crashed writer, but without the data
+            # fsync a machine crash can leave the (renamed) file with
+            # torn contents — the serving snapshots [ISSUE 3] rely on
+            # rename-implies-complete.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)       # persist the rename itself
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # directory fsync unsupported on this platform
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
