@@ -233,6 +233,43 @@ _CHAOS_BENCH_SPEC = {"faults": [
 ]}
 
 
+def _batch_chaos_record(spec=None):
+    """Batch-path chaos rider [ISSUE 4]: a small mesh Monte-Carlo sweep
+    run under a device-loss schedule, with the elastic re-shard
+    completing it over the survivors. Returns the sweep's recovery
+    counters plus a parity bit against the fault-free sweep — the
+    training-side twin of the --streaming --chaos record."""
+    import jax
+
+    from tuplewise_tpu.harness.variance import (
+        VarianceConfig, run_variance_experiment,
+    )
+    from tuplewise_tpu.testing.chaos import FaultInjector
+
+    n_dev = jax.device_count()
+    width = min(2, n_dev)
+    # dropping a worker needs a spare to backfill the fixed-width mesh
+    dropped = [1] if n_dev >= 3 and width == 2 else []
+    default = {"faults": [{"point": "mesh_mc", "on_call": 2,
+                           "action": "error", "dropped": dropped}]}
+    cfg = VarianceConfig(kernel="auc", scheme="local", backend="mesh",
+                         n_pos=4096, n_neg=4096, n_workers=width,
+                         n_reps=8, seed=0)
+    ref = run_variance_experiment(cfg)
+    chaos = FaultInjector.from_spec(spec or default)
+    res = run_variance_experiment(
+        cfg, chaos=chaos, checkpoint_path=None)
+    rec = dict(res["recovery"])
+    rec["mean_matches_fault_free"] = res["mean"] == ref["mean"]
+    rec["n_reps"] = cfg.n_reps
+    print(
+        f"[bench] batch chaos: reshard_events={rec['reshard_events']} "
+        f"retries={rec['retries_total']} "
+        f"parity={rec['mean_matches_fault_free']}", file=sys.stderr,
+    )
+    return rec
+
+
 def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
                               window=None, baseline_events=2_000,
                               bg_compact=True, max_inflight=64,
@@ -372,10 +409,13 @@ def main():
                     help="compact on the batcher thread (pre-PR2 "
                          "behavior); skips the sync comparison run")
     ap.add_argument("--chaos", action="store_true",
-                    help="run the streaming bench under a seeded fault "
-                         "schedule (compactor crash + batcher crash + "
-                         "poison events); adds recovery counters to the "
-                         "record")
+                    help="run under a seeded fault schedule: with "
+                         "--streaming, the serving schedule (compactor "
+                         "crash + batcher crash + poison); without, a "
+                         "batch-path device-loss schedule through the "
+                         "mesh Monte-Carlo sweep (elastic re-shard) — "
+                         "recovery counters ride in the record either "
+                         "way")
     ap.add_argument("--chaos-spec", type=str, default=None,
                     help="override the default --chaos schedule (JSON "
                          "inline, @file, or *.json path)")
@@ -405,6 +445,11 @@ def main():
             rec["anyn_n"] = (1 << 20) + 64
     except Exception as e:  # pragma: no cover - diagnostic only
         print(f"[bench] any-n diagnostic failed ({e!r})", file=sys.stderr)
+    if args.chaos:
+        try:
+            rec["batch_chaos"] = _batch_chaos_record(args.chaos_spec)
+        except Exception as e:  # pragma: no cover - diagnostic only
+            print(f"[bench] batch chaos failed ({e!r})", file=sys.stderr)
     ref = _numpy_pairs_per_sec()
     rec["vs_baseline"] = round(tpu / ref, 2)
     # the caveat the dashboard needs, IN the record, not just stderr

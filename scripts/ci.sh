@@ -33,4 +33,14 @@ if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 # writes results/chaos_smoke.jsonl for the CI artifact.
 timeout -k 10 240 env JAX_PLATFORMS=cpu \
     python scripts/chaos_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Preemption smoke [ISSUE 4]: SIGKILL a short SGD run and a mesh
+# Monte-Carlo sweep right after a checkpoint lands (chaos 'sigkill'
+# action), resume each with --resume, and assert the final
+# params/estimates are bit-identical to the uninterrupted runs;
+# writes results/preemption_smoke.jsonl for the CI artifact.
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/preemption_smoke.py
 exit $?

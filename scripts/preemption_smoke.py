@@ -1,0 +1,112 @@
+"""CI preemption smoke [ISSUE 4 satellite].
+
+The batch-path acceptance cycle, end to end through the real CLI:
+
+1. run a short pairwise-SGD job uninterrupted and record its
+   params digest;
+2. rerun it with a chaos schedule that SIGKILLs the process right
+   after its 2nd checkpoint lands (real preemption: the process dies
+   mid-epoch, uncatchably);
+3. rerun with ``--resume`` and assert the final params digest (and
+   AUC) are bit-identical to the uninterrupted run;
+4. same cycle for the mesh Monte-Carlo sweep (``variance
+   --backend mesh``), asserting mean/variance parity.
+
+Appends the row (stage "preemption_smoke") to a JSONL the workflow
+uploads as an artifact. Exits nonzero on a missed kill, a missing
+checkpoint, or any parity breach.
+
+Usage: python scripts/preemption_smoke.py [--out results/preemption_smoke.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_KILL_SPEC = json.dumps({"faults": [
+    {"point": "checkpoint", "on_call": 2, "action": "sigkill"}]})
+
+
+def _cli(args, expect_kill=False):
+    p = subprocess.run(
+        [sys.executable, "-m", "tuplewise_tpu.harness.cli"] + args,
+        capture_output=True, text=True, env=dict(os.environ), cwd=REPO,
+        timeout=300)
+    if expect_kill:
+        assert p.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL death, got rc={p.returncode}:\n"
+            f"{p.stderr[-2000:]}")
+        return None
+    assert p.returncode == 0, p.stderr[-2000:]
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def _cycle(name, args, ck, fields):
+    """straight -> killed -> resumed; returns the parity record."""
+    ref = _cli(list(args))
+    _cli(args + ["--checkpoint", ck, "--checkpoint-every", "2",
+                 "--chaos-spec", _KILL_SPEC], expect_kill=True)
+    assert os.path.exists(ck), f"{name}: no checkpoint survived the kill"
+    res = _cli(args + ["--checkpoint", ck, "--checkpoint-every", "2",
+                       "--resume"])
+    rec = {"resumed_from": res["recovery"]["resumed_from"]}
+    assert rec["resumed_from"] > 0, f"{name}: resume started from 0"
+    for f in fields:
+        assert res[f] == ref[f], (
+            f"{name}: {f} diverged after SIGKILL+resume: "
+            f"{res[f]!r} != {ref[f]!r}")
+        rec[f] = res[f]
+    print(f"[preemption_smoke] {name}: bit-identical after "
+          f"SIGKILL@step{rec['resumed_from']} + --resume",
+          file=sys.stderr)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "preemption_smoke.jsonl"))
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        row = {"stage": "preemption_smoke", "ok": True}
+        row["pairwise_sgd"] = _cycle(
+            "pairwise_sgd",
+            ["train", "--dataset", "gaussians", "--n", "256",
+             "--steps", "8", "--n-workers", "2"],
+            os.path.join(tmp, "sgd.npz"),
+            ["params_sha256", "auc_test", "loss_last"])
+        row["mesh_mc"] = _cycle(
+            "mesh_mc",
+            ["variance", "--backend", "mesh", "--scheme", "local",
+             "--n-pos", "128", "--n-neg", "128", "--n-workers", "2",
+             "--n-reps", "6", "--seed", "3"],
+            os.path.join(tmp, "mc.npz"),
+            ["mean", "variance"])
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row) + "\n")
+    print(f"[preemption_smoke] OK -> {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,18 +31,32 @@ class Estimator:
       backend: "numpy" (serial oracle), "jax" (single-device XLA),
         or "mesh" (SPMD over a device mesh).
       n_workers: default number of (simulated or real) workers N.
+      heal_retries: > 0 arms elastic self-healing for mesh backends
+        [ISSUE 4]: a scheme call that fails (device death surfaces as
+        the dispatch raising) probes the mesh, rebuilds it at the SAME
+        shard count over the surviving device pool, rebuilds the
+        backend on it, and retries with bounded jittered backoff
+        (``parallel.self_heal.MeshHealer``). Values are unchanged by a
+        reshard: backends re-pack inputs per call and every key folds
+        from (seed, shard index), never from physical placement. 0
+        (default) = no retry wrapper, zero overhead.
+      chaos: a ``testing.chaos.FaultInjector`` fired at the
+        ``estimator`` hook before each scheme call (and consulted for
+        the declared dead-worker topology during a heal).
       **backend_opts: forwarded to the backend constructor
         (e.g. block_size, mesh).
     """
 
     def __init__(self, kernel="auc", backend: str = "numpy",
-                 n_workers: Optional[int] = None, **backend_opts):
+                 n_workers: Optional[int] = None, heal_retries: int = 0,
+                 chaos=None, **backend_opts):
         self.kernel = get_kernel(kernel)
         self.backend_name = backend
         if (backend == "mesh" and "mesh" not in backend_opts
                 and "n_workers" not in backend_opts and n_workers is not None):
             # one worker per chip: size the mesh from n_workers
             backend_opts["n_workers"] = n_workers
+        self._backend_opts = dict(backend_opts)
         self.backend = get_backend(backend, self.kernel, **backend_opts)
         if hasattr(self.backend, "n_shards"):
             # mesh backends pin N to the mesh (one worker per chip); an
@@ -56,6 +70,42 @@ class Estimator:
             self.n_workers = self.backend.n_shards
         else:
             self.n_workers = 1 if n_workers is None else int(n_workers)
+        self.chaos = chaos
+        self.heal_retries = int(heal_retries)
+        self._healer = None
+        if self.heal_retries and backend == "mesh":
+            import jax
+
+            from tuplewise_tpu.parallel.self_heal import MeshHealer
+
+            self._healer = MeshHealer(
+                self.backend.mesh, fixed_width=self.backend.n_shards,
+                pool=list(jax.devices()), chaos=chaos)
+
+    # ------------------------------------------------------------------ #
+    def _call(self, fn):
+        """Run one scheme call, optionally under the shared
+        heal-and-retry protocol [ISSUE 4]."""
+        def attempt():
+            if self.chaos is not None:
+                self.chaos.fire("estimator")
+            return fn(self.backend)
+
+        if self._healer is None:
+            return attempt()
+        return self._healer.run(attempt, retries=self.heal_retries,
+                                on_heal=self._on_heal)
+
+    def _on_heal(self, healer):
+        """Rebuild the mesh backend on the healed mesh (same shard
+        count — the experiment's N is semantic, so lost slots were
+        backfilled from spares). Inputs are re-packed per call, so no
+        other state needs re-placement."""
+        opts = dict(self._backend_opts)
+        opts.pop("mesh", None)
+        opts.pop("n_workers", None)
+        self.backend = get_backend("mesh", self.kernel,
+                                   mesh=healer.mesh, **opts)
 
     # ------------------------------------------------------------------ #
     def _resolve_workers(self, n_workers: Optional[int]) -> int:
@@ -103,7 +153,7 @@ class Estimator:
     def complete(self, A, B=None) -> float:
         """Complete U_n — every tuple, the gold standard [SURVEY §1.2.1]."""
         A, B = self._prep(A, B)
-        return float(self.backend.complete(A, B))
+        return float(self._call(lambda be: be.complete(A, B)))
 
     def local_average(self, A, B=None, *, seed: int = 0,
                       scheme: str = "swor",
@@ -114,9 +164,9 @@ class Estimator:
         [SURVEY §1.2.2]. ``dropped_workers``: failed workers to exclude,
         renormalizing over survivors (parallel.faults, SURVEY §5.4)."""
         A, B = self._prep(A, B)
-        return float(self.backend.local_average(
+        return float(self._call(lambda be: be.local_average(
             A, B, n_workers=self._resolve_workers(n_workers),
-            seed=seed, scheme=scheme, dropped_workers=dropped_workers))
+            seed=seed, scheme=scheme, dropped_workers=dropped_workers)))
 
     def repartitioned(self, A, B=None, *, n_rounds: int, seed: int = 0,
                       scheme: str = "swor",
@@ -128,10 +178,10 @@ class Estimator:
         if n_rounds < 1:
             raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")
         A, B = self._prep(A, B)
-        return float(self.backend.repartitioned(
+        return float(self._call(lambda be: be.repartitioned(
             A, B, n_workers=self._resolve_workers(n_workers),
             n_rounds=n_rounds, seed=seed, scheme=scheme,
-            dropped_workers=dropped_workers))
+            dropped_workers=dropped_workers)))
 
     def incomplete(self, A, B=None, *, n_pairs: int, seed: int = 0,
                    design: str = "swr") -> float:
@@ -142,5 +192,5 @@ class Estimator:
         if n_pairs < 1:
             raise ValueError(f"n_pairs must be >= 1, got {n_pairs}")
         A, B = self._prep(A, B)
-        return float(self.backend.incomplete(
-            A, B, n_pairs=n_pairs, seed=seed, design=design))
+        return float(self._call(lambda be: be.incomplete(
+            A, B, n_pairs=n_pairs, seed=seed, design=design)))

@@ -6,6 +6,8 @@
     python -m tuplewise_tpu.harness.cli tradeoff-workers --workers 8 1000 125000
     python -m tuplewise_tpu.harness.cli triplet --n 2000
     python -m tuplewise_tpu.harness.cli train --dataset adult --steps 100
+    python -m tuplewise_tpu.harness.cli train --checkpoint ck.npz --resume
+    python -m tuplewise_tpu.harness.cli train-triplet --steps 50
     python -m tuplewise_tpu.harness.cli learning --n-workers 128 --repartition-every 25
     python -m tuplewise_tpu.harness.cli replay --n-events 20000 --budget 64
     echo '{"op":"insert","score":1.2,"label":1}' | python -m tuplewise_tpu.harness.cli serve
@@ -34,6 +36,36 @@ from tuplewise_tpu.harness.variance import (
     tradeoff_vs_workers,
     write_jsonl,
 )
+
+
+def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
+    """The batch-path fault-tolerance flags [ISSUE 4], shared by every
+    long-running subcommand: checkpoint cadence, explicit resume, and
+    deterministic chaos injection."""
+    p.add_argument("--checkpoint", type=str, default=None,
+                   help="atomic progress checkpoint (.npz); written "
+                        "every --checkpoint-every units of progress")
+    p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from an existing --checkpoint file "
+                        "(bit-identical to the uninterrupted run); "
+                        "without this flag a stale checkpoint is "
+                        "removed and the run starts fresh")
+    p.add_argument("--chaos-spec", type=str, default=None,
+                   help="deterministic fault schedule (JSON inline, "
+                        "@file, or *.json path) injected into the "
+                        "batch-path hook points (train_step / mc_chunk "
+                        "/ mesh_mc / checkpoint / estimator; action "
+                        "'sigkill' at a checkpoint models preemption)")
+
+
+def _chaos_from(args):
+    spec = getattr(args, "chaos_spec", None)
+    if not spec:
+        return None
+    from tuplewise_tpu.testing.chaos import FaultInjector
+
+    return FaultInjector.from_spec(spec)
 
 
 def _add_budget_flags(p: argparse.ArgumentParser) -> None:
@@ -163,8 +195,7 @@ def main(argv=None) -> int:
         _add_variance_args(p)
         p.add_argument("--out", type=str, default=None)
         if name == "variance":
-            p.add_argument("--checkpoint", type=str, default=None)
-            p.add_argument("--checkpoint-every", type=int, default=None)
+            _add_robustness_flags(p)
             p.add_argument("--trace-dir", type=str, default=None,
                            help="write a jax.profiler trace here")
         if name == "tradeoff-rounds":
@@ -184,6 +215,7 @@ def main(argv=None) -> int:
     p.add_argument("--n-pairs", type=int, default=20_000)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", type=str, default=None)
+    _add_robustness_flags(p)
 
     p = sub.add_parser(
         "learning",
@@ -220,8 +252,26 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--n", type=int, default=8000)
     p.add_argument("--out", type=str, default=None)
-    p.add_argument("--checkpoint", type=str, default=None)
-    p.add_argument("--checkpoint-every", type=int, default=None)
+    _add_robustness_flags(p)
+
+    p = sub.add_parser(
+        "train-triplet",
+        help="degree-3 metric-learning SGD on synthetic Gaussian "
+             "classes (models.triplet_sgd) with the full "
+             "checkpoint/resume + chaos robustness surface",
+    )
+    p.add_argument("--n", type=int, default=512,
+                   help="rows per class (anchors/positives vs negatives)")
+    p.add_argument("--dim", type=int, default=8)
+    p.add_argument("--embed-dim", type=int, default=4)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--n-workers", type=int, default=1)
+    p.add_argument("--repartition-every", type=int, default=10)
+    p.add_argument("--triplets-per-worker", type=int, default=1024)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", type=str, default=None)
+    _add_robustness_flags(p)
 
     def _add_serving_flags(p: argparse.ArgumentParser) -> None:
         """ServingConfig knobs shared by serve and replay."""
@@ -264,6 +314,14 @@ def main(argv=None) -> int:
         p.add_argument("--recover", action="store_true",
                        help="restore --snapshot-dir state (snapshot + "
                             "WAL tail) before serving")
+        p.add_argument("--wal-fsync", default="snapshot",
+                       choices=["snapshot", "batch"],
+                       help="WAL durability: 'snapshot' (default) "
+                            "flushes per batch and fsyncs only at "
+                            "snapshots (survives SIGKILL; power loss "
+                            "can drop the tail), 'batch' fsyncs every "
+                            "append (closes the power-loss window at "
+                            "per-batch latency cost — DESIGN §9)")
         p.add_argument("--seed", type=int, default=0)
 
     p = sub.add_parser(
@@ -306,6 +364,7 @@ def main(argv=None) -> int:
                         if args.deadline_ms is not None else None),
             snapshot_dir=args.snapshot_dir,
             snapshot_every=args.snapshot_every, recover=args.recover,
+            wal_fsync=args.wal_fsync,
             seed=args.seed,
         )
         chaos = None
@@ -329,12 +388,16 @@ def main(argv=None) -> int:
         return _serve_stdin(cfg, chaos=chaos)
 
     if args.cmd == "variance":
+        from tuplewise_tpu.utils.checkpoint import prepare_resume
+
+        prepare_resume(args.checkpoint, args.resume)
         _emit(
             run_variance_experiment(
                 _cfg_from_args(args),
                 checkpoint_path=args.checkpoint,
                 checkpoint_every=args.checkpoint_every,
                 trace_dir=args.trace_dir,
+                chaos=_chaos_from(args),
             ),
             args.out,
         )
@@ -351,11 +414,15 @@ def main(argv=None) -> int:
         from tuplewise_tpu.harness.triplet_experiment import (
             triplet_mnist_statistic,
         )
+        from tuplewise_tpu.utils.checkpoint import prepare_resume
 
+        prepare_resume(args.checkpoint, args.resume)
         _emit(
             triplet_mnist_statistic(
                 kernel=args.kernel, backend=args.backend, n=args.n,
                 n_pairs=args.n_pairs, seed=args.seed,
+                checkpoint_path=args.checkpoint,
+                chaos=_chaos_from(args),
             ),
             args.out,
         )
@@ -434,10 +501,16 @@ def main(argv=None) -> int:
             pair_design=args.pair_design,
             loss_every=args.loss_every or NEVER, seed=args.seed,
         )
+        from tuplewise_tpu.utils.checkpoint import (
+            params_digest, prepare_resume,
+        )
+
+        prepare_resume(args.checkpoint, args.resume)
         params, hist = train_pairwise(
             scorer, p0, Xp, Xn, cfg,
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
+            chaos=_chaos_from(args),
         )
         _emit(
             {
@@ -455,6 +528,49 @@ def main(argv=None) -> int:
                 "loss_last": last_recorded_loss(
                     hist["loss"], cfg.loss_every
                 ),
+                # bit-identity witness for resume/preemption parity
+                # checks across processes [ISSUE 4]
+                "params_sha256": params_digest(params),
+                "recovery": hist.get("recovery"),
+            },
+            args.out,
+        )
+    elif args.cmd == "train-triplet":
+        from tuplewise_tpu.data import make_gaussians
+        from tuplewise_tpu.models.triplet_sgd import (
+            TripletTrainConfig, evaluate_triplet_accuracy, init_embed,
+            train_triplet,
+        )
+        from tuplewise_tpu.utils.checkpoint import (
+            params_digest, prepare_resume,
+        )
+
+        Xc, Xo = make_gaussians(args.n, args.n, dim=args.dim,
+                                separation=1.0, seed=args.seed)
+        cfg = TripletTrainConfig(
+            embed_dim=args.embed_dim, lr=args.lr, steps=args.steps,
+            n_workers=args.n_workers,
+            repartition_every=args.repartition_every,
+            triplets_per_worker=args.triplets_per_worker,
+            seed=args.seed,
+        )
+        prepare_resume(args.checkpoint, args.resume)
+        params, hist = train_triplet(
+            init_embed(args.dim, args.embed_dim, args.seed), Xc, Xo,
+            cfg, checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            chaos=_chaos_from(args),
+        )
+        _emit(
+            {
+                "config": dataclasses.asdict(cfg),
+                "dataset": "gaussians",
+                "loss_first": float(hist["loss"][0]),
+                "loss_last": float(hist["loss"][-1]),
+                "triplet_acc": evaluate_triplet_accuracy(
+                    params, Xc, Xo, n_triplets=4096, seed=args.seed),
+                "params_sha256": params_digest(params),
+                "recovery": hist.get("recovery"),
             },
             args.out,
         )

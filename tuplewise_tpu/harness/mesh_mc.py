@@ -53,11 +53,20 @@ def _clamp_preferred(pref: int, base: int, m: int) -> int:
 
 
 def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
-                        triplet_tile: int = 16):
+                        triplet_tile: int = 16, chaos=None):
     """Compiled rep-array -> estimate-array runner for mesh configs on
     Gaussian data, or None when this config can't run fully on device
     (only meshes of >2 axes; every kernel kind — diff, feature pair,
     triplet — now runs mesh-native).
+
+    ``mesh`` lets the caller place the runner on a SPECIFIC mesh — the
+    elastic re-shard path [ISSUE 4] rebuilds the runner on a healed
+    mesh of the same logical width; estimates depend only on (rep,
+    logical shard index) fold chains, so the rebuilt runner's values
+    are bit-identical to the original's. ``chaos``: a
+    ``testing.chaos.FaultInjector`` fired at the ``mesh_mc`` hook
+    before every dispatch of the compiled program (where a dead device
+    actually surfaces as the dispatch raising).
     """
     kernel = get_kernel(cfg.kernel)
     trip = kernel.kind == "triplet"
@@ -81,6 +90,19 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
     axes = tuple(mesh.axis_names)
     if len(axes) > 2:
         return None
+
+    def _with_chaos(runner):
+        """Wrap the compiled program with the ``mesh_mc`` hook point
+        (host-side, per dispatch — the injector's one-shot faults make
+        a retried dispatch succeed deterministically)."""
+        if chaos is None:
+            return runner
+
+        def chaotic(reps):
+            chaos.fire("mesh_mc")
+            return runner(reps)
+
+        return chaotic
     one_sample = not kernel.two_sample
     n1 = cfg.n_pos
     n2 = n1 if one_sample else cfg.n_neg
@@ -224,7 +246,7 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
                 pw,
             )
 
-        return jax.jit(lambda reps: lax.map(designed_rep, reps))
+        return _with_chaos(jax.jit(lambda reps: lax.map(designed_rep, reps)))
 
     # ---- estimator bodies (mirror backends.mesh_backend) ------------- #
     def complete_body(a, b, ma, mb, ia, ib):
@@ -411,4 +433,4 @@ def make_mesh_mc_runner(cfg, mesh=None, tile: int = 512,
 
     # lax.map (not vmap): each rep already fills the mesh; serializing
     # reps bounds live memory at one rep's working set
-    return jax.jit(lambda reps: lax.map(one_rep, reps))
+    return _with_chaos(jax.jit(lambda reps: lax.map(one_rep, reps)))

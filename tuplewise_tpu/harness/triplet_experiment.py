@@ -9,6 +9,14 @@ positive) pairs from class c and negatives from the other classes:
 and the reported statistic averages U_c over classes — with the
 indicator kernel this is the class-balanced triplet accuracy of the
 embedding (the fraction of relative-similarity constraints satisfied).
+
+Checkpoint/resume [ISSUE 4]: the per-class loop is the long-running
+part (complete statistics are O(n_c^2 * n)), so progress is
+checkpointed per COMPLETED CLASS through ``utils.checkpoint``; a
+preempted sweep resumes at the next class. Per-class values are
+independent (each estimator call is keyed by the class data + ``seed``,
+never by loop state), so a resumed sweep is bit-identical to a
+straight one.
 """
 
 from __future__ import annotations
@@ -29,27 +37,63 @@ def triplet_mnist_statistic(
     classes: Optional[list] = None,
     seed: int = 0,
     path: Optional[str] = None,
+    checkpoint_path: Optional[str] = None,
+    chaos=None,
     **backend_opts,
 ) -> dict:
     """Per-class triplet U-statistics over MNIST embeddings.
 
     n_pairs None -> complete statistic (O(n_c^2 * n) — small n only);
     otherwise the incomplete estimator with B=n_pairs sampled triplets.
+
+    ``checkpoint_path``: persist (class, U_c) pairs after every class
+    and resume a preempted sweep from the next one — bit-identical to
+    the uninterrupted sweep (per-class values are order-independent).
+    ``chaos``: fired at the ``checkpoint`` hook after each save (the
+    ``sigkill`` action models preemption with durable state).
     """
     E, labels, meta = load_mnist_embeddings(path=path, n=n, seed=seed)
-    est = Estimator(kernel, backend=backend, **backend_opts)
+    est = Estimator(kernel, backend=backend, chaos=chaos, **backend_opts)
+    todo = sorted(set(classes or np.unique(labels).tolist()))
+
+    from tuplewise_tpu.utils.checkpoint import (
+        resume_progress, save_checkpoint,
+    )
+
+    ck_config = {"kernel": kernel, "backend": backend, "n": n,
+                 "n_pairs": n_pairs, "classes": [int(c) for c in todo],
+                 "seed": seed, "n_done": len(todo)}
+    start, ck = resume_progress(
+        checkpoint_path, ck_config, progress_key="n_done",
+        requested=len(todo))
     per_class = {}
-    for c in sorted(set(classes or np.unique(labels).tolist())):
+    if ck is not None:
+        per_class = {int(c): float(v) for c, v in zip(
+            ck["extra"]["class_ids"], ck["extra"]["values"])}
+    for i in range(start, len(todo)):
+        c = todo[i]
         Xc = E[labels == c]
         Yc = E[labels != c]
-        if len(Xc) < 2 or len(Yc) < 1:
-            continue
-        if n_pairs is None:
-            per_class[int(c)] = est.complete(Xc, Yc)
-        else:
-            per_class[int(c)] = est.incomplete(
-                Xc, Yc, n_pairs=n_pairs, seed=seed
+        if len(Xc) >= 2 and len(Yc) >= 1:
+            if n_pairs is None:
+                per_class[int(c)] = est.complete(Xc, Yc)
+            else:
+                per_class[int(c)] = est.incomplete(
+                    Xc, Yc, n_pairs=n_pairs, seed=seed
+                )
+        if checkpoint_path:
+            save_checkpoint(
+                checkpoint_path, step=i + 1,
+                extra={
+                    "class_ids": np.asarray(sorted(per_class),
+                                            dtype=np.int64),
+                    "values": np.asarray(
+                        [per_class[c] for c in sorted(per_class)]),
+                },
+                config=ck_config,
             )
+            if chaos is not None:
+                chaos.fire("checkpoint")
     values = list(per_class.values())
     return {
         "per_class": per_class,
@@ -59,4 +103,5 @@ def triplet_mnist_statistic(
         "n": n,
         "n_pairs": n_pairs,
         "data_meta": meta,
+        "recovery": {"resumed_from": int(start)},
     }

@@ -92,7 +92,7 @@ def _estimate_once(est: Estimator, cfg: VarianceConfig, rep: int) -> float:
     raise ValueError(f"unknown scheme {cfg.scheme!r}")
 
 
-def _make_vmapped_runner(cfg: VarianceConfig):
+def _make_vmapped_runner(cfg: VarianceConfig, mesh=None, chaos=None):
     """Compiled rep-array -> estimate-array runner for diff kernels on
     Gaussian scores (one XLA program for the whole Monte-Carlo batch),
     or None if this config isn't compilable end-to-end (feature
@@ -100,11 +100,14 @@ def _make_vmapped_runner(cfg: VarianceConfig):
     mesh-native runner (harness.mesh_mc): generation, reshuffling, and
     estimation all stay on device across reps. Estimates depend only on
     the ABSOLUTE rep indices passed in, so callers may chunk the rep
-    range freely (checkpoint/resume) without changing any value."""
+    range freely (checkpoint/resume) without changing any value —
+    and, for mesh configs, rebuild the runner on a healed mesh of the
+    same logical width (elastic re-shard [ISSUE 4]) without changing
+    any value either."""
     if cfg.backend == "mesh":
         from tuplewise_tpu.harness.mesh_mc import make_mesh_mc_runner
 
-        return make_mesh_mc_runner(cfg)
+        return make_mesh_mc_runner(cfg, mesh=mesh, chaos=chaos)
     if cfg.backend != "jax" or get_kernel(cfg.kernel).kind not in (
             "diff", "triplet"):
         return None
@@ -341,17 +344,34 @@ def run_variance_experiment(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: Optional[int] = None,
     trace_dir: Optional[str] = None,
+    chaos=None,
+    heal_retries: int = 2,
 ) -> dict:
     """M-rep Monte-Carlo [SURVEY §4.5]. Returns a JSON-serializable dict
-    with mean, empirical variance, wall-clock, and the config.
+    with mean, empirical variance, wall-clock, the config, and a
+    ``recovery`` block (resume point + reshard/retry counters).
 
     Checkpoint/resume [SURVEY §5.5]: with ``checkpoint_path``, reps run
     in chunks of ``checkpoint_every`` and partial estimates persist after
     each chunk; an existing checkpoint resumes from its saved rep count
     (cfg.n_reps may grow across resumes; every other field must match).
     Per-rep estimates are keyed by absolute rep index, so chunked and
-    straight runs produce identical estimate arrays. Accumulated compute
-    wall-clock carries across resumes.
+    straight runs produce identical estimate arrays — including across a
+    SIGKILL-and-resume. Accumulated compute wall-clock carries across
+    resumes.
+
+    Elastic re-sharding [ISSUE 4]: a chunk that fails mid-sweep heals
+    through ``parallel.self_heal.MeshHealer`` — probe
+    (``faults.detect_dropped_workers`` or the chaos schedule's declared
+    topology), rebuild the mesh at the SAME logical ``n_workers`` width
+    over the surviving device pool, rebuild the compiled runner on it,
+    retry with bounded jittered backoff (at most ``heal_retries``).
+    Estimates depend only on (rep, logical shard) fold chains, so the
+    healed sweep is bit-identical to a fault-free one. Non-mesh
+    backends share the retry/backoff discipline without the reshard.
+    ``chaos`` fires at ``mc_chunk`` (per chunk), ``mesh_mc`` (per
+    compiled-program dispatch), and ``checkpoint`` (after each save —
+    the ``sigkill`` action models preemption with durable state).
     """
     if cfg.scheme not in _SCHEMES:
         raise ValueError(
@@ -379,42 +399,88 @@ def run_variance_experiment(
         est_parts = [ck["extra"]["estimates"]]
         wallclock = float(ck["extra"]["wallclock_s"])
 
-    runner = _make_vmapped_runner(cfg)
-    vmapped = runner is not None
-    if vmapped:
-        import jax.numpy as jnp
+    from tuplewise_tpu.parallel.self_heal import Backoff, MeshHealer
 
-        warmed = set()
+    mesh = None
+    if cfg.backend == "mesh":
+        from tuplewise_tpu.parallel.mesh import make_mesh
 
-        def run_chunk(m, chunk):
+        mesh = make_mesh(cfg.n_workers)
+
+    # the runner/estimator live in a rebuildable cell: an elastic
+    # re-shard rebuilds them on the healed mesh mid-sweep
+    state: dict = {}
+
+    def build(m):
+        state["runner"] = _make_vmapped_runner(cfg, mesh=m, chaos=chaos)
+        state["warmed"] = set()
+        if state["runner"] is None:
+            opts = {"mesh": m} if m is not None else {}
+            state["est"] = Estimator(
+                cfg.kernel, backend=cfg.backend,
+                n_workers=cfg.n_workers, **opts
+            )
+
+    build(mesh)
+    vmapped = state["runner"] is not None
+
+    healer = None
+    if heal_retries:
+        if mesh is not None:
+            import jax
+
+            healer = MeshHealer(
+                mesh, fixed_width=cfg.n_workers,
+                pool=list(jax.devices()), chaos=chaos,
+                backoff=Backoff(seed=cfg.seed))
+        else:
+            # non-mesh backends: shared retry/backoff, no reshard
+            healer = MeshHealer(None, chaos=chaos,
+                                backoff=Backoff(seed=cfg.seed))
+
+    def on_heal(h):
+        if h.mesh is not None:
+            build(h.mesh)
+
+    def run_chunk(m, chunk):
+        if state["runner"] is not None:
+            import jax.numpy as jnp
+
             reps = jnp.arange(m, m + chunk)
-            if chunk not in warmed:
+            if chunk not in state["warmed"]:
                 # compile outside the timing window: wallclock stays
-                # compute-only, which the variance-vs-wallclock trade-off
-                # figure needs
-                np.asarray(runner(reps))
-                warmed.add(chunk)
-            return lambda: np.asarray(runner(reps))  # host copy = synced
-    else:
-        est = Estimator(
-            cfg.kernel, backend=cfg.backend, n_workers=cfg.n_workers
-        )
-
-        def run_chunk(m, chunk):
-            return lambda: np.asarray([
-                _estimate_once(est, cfg, r) for r in range(m, m + chunk)
-            ])
+                # compute-only, which the variance-vs-wallclock
+                # trade-off figure needs
+                np.asarray(state["runner"](reps))
+                state["warmed"].add(chunk)
+            # host copy = synced
+            return lambda: np.asarray(state["runner"](reps))
+        est = state["est"]
+        return lambda: np.asarray([
+            _estimate_once(est, cfg, r) for r in range(m, m + chunk)
+        ])
 
     from tuplewise_tpu.utils.profiling import annotate, timer, trace
 
     with trace(trace_dir):  # jax.profiler scope when requested [§5.2]
         for m, chunk in iter_chunks(start, cfg.n_reps, checkpoint_every):
-            timed = run_chunk(m, chunk)  # warm-up outside the window
-            # named span per chunk so the trace digest attributes time
-            # to rep ranges, not one undifferentiated blob [§5.2]
-            with timer() as t, annotate(f"mc_reps[{m}:{m + chunk}]"):
-                est_parts.append(timed())
-            wallclock += t["seconds"]
+            def attempt(m=m, chunk=chunk):
+                if chaos is not None:
+                    chaos.fire("mc_chunk")
+                timed = run_chunk(m, chunk)  # warm-up outside the window
+                # named span per chunk so the trace digest attributes
+                # time to rep ranges, not one undifferentiated blob
+                with timer() as t, annotate(f"mc_reps[{m}:{m + chunk}]"):
+                    out = timed()
+                return out, t["seconds"]
+
+            if healer is not None:
+                out, secs = healer.run(attempt, retries=heal_retries,
+                                       on_heal=on_heal)
+            else:
+                out, secs = attempt()
+            est_parts.append(out)
+            wallclock += secs
             if checkpoint_path:
                 save_checkpoint(
                     checkpoint_path,
@@ -425,6 +491,10 @@ def run_variance_experiment(
                     },
                     config=cfg.to_json(),
                 )
+                if chaos is not None:
+                    # durable-state preemption point: a 'sigkill' here
+                    # dies with exactly m + chunk reps recoverable
+                    chaos.fire("checkpoint")
     estimates = np.concatenate(est_parts) if est_parts else np.empty(0)
     try:
         import jax
@@ -445,7 +515,17 @@ def run_variance_experiment(
         "wallclock_s": wallclock,
         "vmapped": vmapped,
         "n_reps": cfg.n_reps,
+        # fault-tolerance observability [ISSUE 4]: how this row was
+        # produced — fresh or resumed, and what recovery fired
+        "recovery": {
+            "resumed_from": int(start),
+            "reshard_events": healer.reshard_events if healer else 0,
+            "retries_total": healer.retries_total if healer else 0,
+            "mesh_workers": healer.n_workers if healer else None,
+        },
     }
+    if chaos is not None:
+        result["recovery"]["chaos"] = chaos.snapshot()
     if trace_dir:
         result["trace_dir"] = trace_dir
     if cfg.kernel == "auc" and cfg.dim == 1:

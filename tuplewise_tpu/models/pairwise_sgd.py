@@ -227,6 +227,9 @@ def train_pairwise(
     mesh=None,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: Optional[int] = None,
+    chaos=None,
+    heal_retries: int = 2,
+    retry_backoff_s: float = 0.05,
 ):
     """Distributed pairwise SGD over a device mesh.
 
@@ -242,7 +245,25 @@ def train_pairwise(
     checkpoint resumes from its saved step. Resume is EXACT: every key
     is folded from the absolute step index, so a chunked run reproduces
     the unchunked run bit-for-bit (cfg.steps may differ across resumes;
-    every other config field must match).
+    every other config field must match) — including across a SIGKILL:
+    the trajectory is a function of (step, seed) only, never of where
+    the last process died.
+
+    Elastic re-sharding [ISSUE 4]: a chunk that fails (device death
+    surfaces as the dispatch raising) runs the shared heal-and-retry
+    protocol (``parallel.self_heal.MeshHealer``): probe, rebuild the
+    mesh AT THE SAME logical width over the surviving device pool
+    (``jax.devices()`` spares backfill lost slots — n_workers is part
+    of the experiment's semantics, so the width must not drift),
+    re-place the data blocks and params, rebuild the compiled chunk,
+    retry with bounded jittered backoff (at most ``heal_retries``
+    times). The resumed trajectory is bit-identical because every key
+    folds from absolute step indices — physical placement never enters
+    the math. When spares run out (``HealExhaustedError``) the job is
+    left to checkpoint/resume on a healthy pool. ``chaos``: a
+    ``testing.chaos.FaultInjector`` fired at the ``train_step`` hook
+    (before each chunk) and ``checkpoint`` hook (after each save —
+    where the ``sigkill`` action models real preemption).
     """
     kernel = get_kernel(cfg.kernel)
     if kernel.kind != "diff":
@@ -314,13 +335,47 @@ def train_pairwise(
         if start == cfg.steps:
             return (
                 jax.tree.map(np.asarray, params),
-                {"loss": np.concatenate(loss_parts)},
+                {"loss": np.concatenate(loss_parts),
+                 "recovery": {"resumed_from": int(start),
+                              "reshard_events": 0, "retries_total": 0,
+                              "mesh_workers": N}},
             )
 
+    # ---- elastic heal-and-retry around each chunk [ISSUE 4] ---------- #
+    from tuplewise_tpu.parallel.self_heal import Backoff, MeshHealer
+
+    healer = None
+    if heal_retries:
+        healer = MeshHealer(
+            mesh, fixed_width=N, pool=list(jax.devices()), chaos=chaos,
+            backoff=Backoff(base_s=retry_backoff_s, seed=cfg.seed))
+
+    def on_heal(h):
+        # adopt the healed mesh and re-place EVERYTHING on it: data
+        # blocks, replicated params (host round-trip — the old mesh's
+        # buffers may be torn), and the compiled chunk program
+        nonlocal mesh, replicated, shard_blocks, Xp, Xn, params, run_chunk
+        mesh = h.mesh
+        replicated = NamedSharding(mesh, P())
+        shard_blocks = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+        Xp, Xn = pad_put(X_pos, mesh), pad_put(X_neg, mesh)
+        params = jax.device_put(jax.tree.map(np.asarray, params),
+                                replicated)
+        run_chunk = _compiled_trainer(
+            scorer, dataclasses.replace(cfg, steps=0), mesh, n1, n2)
+
     for t, chunk in iter_chunks(start, cfg.steps, checkpoint_every):
-        params, losses = run_chunk(
-            params, Xp, Xn, jnp.asarray(t, jnp.int32), chunk
-        )
+        def attempt(t=t, chunk=chunk):
+            if chaos is not None:
+                chaos.fire("train_step")
+            return run_chunk(params, Xp, Xn, jnp.asarray(t, jnp.int32),
+                             chunk)
+
+        if healer is not None:
+            params, losses = healer.run(attempt, retries=heal_retries,
+                                        on_heal=on_heal)
+        else:
+            params, losses = attempt()
         loss_parts.append(np.asarray(losses))
         if checkpoint_path:
             save_checkpoint(
@@ -330,10 +385,20 @@ def train_pairwise(
                 extra={"loss": np.concatenate(loss_parts)},
                 config=dataclasses.asdict(cfg),
             )
-    return (
-        jax.tree.map(np.asarray, params),
-        {"loss": np.concatenate(loss_parts)},
-    )
+            if chaos is not None:
+                # deterministic preemption point: the checkpoint above
+                # is durable, so a 'sigkill' scheduled here dies with
+                # exactly t + chunk steps recoverable
+                chaos.fire("checkpoint")
+    history = {"loss": np.concatenate(loss_parts)}
+    if healer is not None:
+        history["recovery"] = {
+            "resumed_from": int(start),
+            "reshard_events": healer.reshard_events,
+            "retries_total": healer.retries_total,
+            "mesh_workers": healer.n_workers,
+        }
+    return jax.tree.map(np.asarray, params), history
 
 
 # --------------------------------------------------------------------- #

@@ -191,6 +191,9 @@ def train_triplet(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: Optional[int] = None,
     embedder=None,
+    chaos=None,
+    heal_retries: int = 2,
+    retry_backoff_s: float = 0.05,
 ):
     """Distributed triplet SGD: anchors/positives from X_class (the
     target class), negatives from X_other. Returns (params, history);
@@ -212,7 +215,14 @@ def train_triplet(
     may grow across resumes; every other field must match). Scan
     chunks realign to ABSOLUTE eval/checkpoint boundaries, so a resume
     from any saved step evaluates at the same steps as the straight
-    run."""
+    run.
+
+    Elastic re-sharding + chaos [ISSUE 4, same contract as
+    train_pairwise]: a failed chunk heals through
+    ``parallel.self_heal.MeshHealer`` — probe, rebuild the mesh at the
+    SAME logical width from the spare-device pool, re-place data and
+    params, retry with bounded jittered backoff. ``chaos`` fires at the
+    ``train_step`` / ``checkpoint`` hook points."""
     kernel = get_kernel(cfg.kernel)
     if kernel.kind != "triplet":
         raise ValueError(
@@ -300,13 +310,44 @@ def train_triplet(
             },
             config=ck_config,
         )
+        if chaos is not None:
+            # durable-state preemption point ('sigkill' dies here)
+            chaos.fire("checkpoint")
+
+    # ---- elastic heal-and-retry around each chunk [ISSUE 4] ---------- #
+    from tuplewise_tpu.parallel.self_heal import Backoff, MeshHealer
+
+    healer = None
+    if heal_retries:
+        healer = MeshHealer(
+            mesh, fixed_width=N, pool=list(jax.devices()), chaos=chaos,
+            backoff=Backoff(base_s=retry_backoff_s, seed=cfg.seed))
+
+    def on_heal(h):
+        nonlocal mesh, replicated, Xc, Xo, params, run_chunk
+        mesh = h.mesh
+        replicated = NamedSharding(mesh, P())
+        Xc, Xo = pad_put(X_class, mesh), pad_put(X_other, mesh)
+        params = jax.device_put(jax.tree.map(np.asarray, params),
+                                replicated)
+        run_chunk = _compiled_triplet_trainer(
+            embedder, dataclasses.replace(cfg, steps=0), mesh, n1, n2)
 
     t0 = start
     while t0 < cfg.steps:
         t1 = next_boundary(t0)
-        params, losses = run_chunk(
-            params, Xc, Xo, jnp.asarray(t0, jnp.int32), t1 - t0
-        )
+
+        def attempt(t0=t0, t1=t1):
+            if chaos is not None:
+                chaos.fire("train_step")
+            return run_chunk(params, Xc, Xo, jnp.asarray(t0, jnp.int32),
+                             t1 - t0)
+
+        if healer is not None:
+            params, losses = healer.run(attempt, retries=heal_retries,
+                                        on_heal=on_heal)
+        else:
+            params, losses = attempt()
         loss_parts.append(np.asarray(losses))
         if eval_every is not None and (
             t1 % eval_every == 0 or t1 == cfg.steps
@@ -329,6 +370,13 @@ def train_triplet(
     if eval_every is not None:
         hist["eval_steps"] = np.asarray(curve_steps)
         hist["test_acc"] = np.asarray(curve_acc)
+    if healer is not None:
+        hist["recovery"] = {
+            "resumed_from": int(start),
+            "reshard_events": healer.reshard_events,
+            "retries_total": healer.retries_total,
+            "mesh_workers": healer.n_workers,
+        }
     return jax.tree.map(np.asarray, params), hist
 
 

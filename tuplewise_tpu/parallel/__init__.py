@@ -16,11 +16,19 @@ from tuplewise_tpu.parallel.partition import (
     partition_indices,
     partition_two_sample,
 )
+from tuplewise_tpu.parallel.self_heal import (
+    Backoff,
+    HealExhaustedError,
+    MeshHealer,
+)
 
 # tuplewise_tpu.parallel.distributed (multi-process launch) is likewise
 # not imported here: it is jax-adjacent and must run BEFORE jax init.
 
 __all__ = [
+    "Backoff",
+    "HealExhaustedError",
+    "MeshHealer",
     "alive_mask",
     "detect_dropped_workers",
     "draw_pair_design",

@@ -45,6 +45,10 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    retries: int = 0,
+    retry_backoff_s: float = 0.5,
+    chaos=None,
 ) -> bool:
     """Bring up the JAX process group; returns True when distributed
     mode is active.
@@ -53,6 +57,13 @@ def initialize(
     flags apply; with neither, this is a no-op (single-process mode) —
     the flag-gating of VERDICT r2 next #8. Must run before any jax
     computation, like jax.distributed.initialize itself.
+
+    ``retries`` [ISSUE 4]: bring-up on a preempted-and-restarted pod is
+    racy — the coordinator may not be listening yet when a restarted
+    worker comes back. Failed initialization retries with the shared
+    bounded jittered backoff (``parallel.self_heal.Backoff``) before
+    surfacing the error. ``chaos`` fires the ``dist_init`` hook before
+    each attempt (deterministic bring-up-failure injection in tests).
     """
     env = dist_env()
     coordinator_address = coordinator_address or env.get("coordinator")
@@ -73,12 +84,26 @@ def initialize(
         )
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=int(num_processes),
-        process_id=int(process_id),
-    )
-    return True
+    from tuplewise_tpu.parallel.self_heal import Backoff
+
+    backoff = Backoff(base_s=retry_backoff_s, cap_s=10.0,
+                      seed=int(process_id))
+    attempt = 0
+    while True:
+        try:
+            if chaos is not None:
+                chaos.fire("dist_init")
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=int(num_processes),
+                process_id=int(process_id),
+            )
+            return True
+        except Exception:
+            attempt += 1
+            if attempt > retries:
+                raise
+            backoff.sleep(attempt)
 
 
 def global_mesh():

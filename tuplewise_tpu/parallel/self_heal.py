@@ -1,0 +1,244 @@
+"""Elastic mesh self-healing — shared by serving AND the batch path
+[ISSUE 4].
+
+PR 3 taught the serving index to survive device loss with a private
+recovery loop (probe → rebuild the mesh over survivors → re-place the
+host-authoritative data → bounded backoff → retry). The batch path —
+SGD trainers, the mesh Monte-Carlo driver, the Estimator itself — needs
+the identical protocol, so this module factors it out:
+
+* :class:`Backoff` — ONE bounded-exponential-backoff implementation
+  (with deterministic seeded jitter so synchronized retry storms
+  de-correlate), replacing the ad-hoc ``sleep(min(base * 2**k, cap))``
+  the serving index carried privately.
+* :class:`MeshHealer` — owns the mutable mesh reference plus the
+  recovery counters (``reshard_events`` / ``shard_retries_total`` /
+  ``recovery_time_s``, the same metric names the serving exit summary
+  and ``bench.py --chaos`` report). ``run(fn)`` executes a mesh
+  computation with the full heal-and-retry protocol around it.
+
+Two reshard policies, chosen by who can tolerate a width change:
+
+* **shrink** (``fixed_width=None``, the serving index): rebuild over
+  the survivors of the CURRENT mesh. Counting is additive over any
+  partition, so sharded counts stay bit-identical at any width.
+* **fixed width** (``fixed_width=N``, trainers / mesh Monte-Carlo):
+  the logical worker count is part of the experiment's semantics
+  (every PRNG key folds a shard index; block sizes are n // N), so a
+  reshard must KEEP the width — lost slots are backfilled from the
+  spare-device ``pool``. Results are then bit-identical by
+  construction: values depend on (rep, step, logical shard index),
+  never on which physical chip computed them. When the pool can no
+  longer sustain the width, :class:`HealExhaustedError` is raised —
+  the job falls back to checkpoint/resume on a healthy pool rather
+  than silently continuing a DIFFERENT experiment at a smaller N.
+
+A ``MeshHealer(mesh=None)`` degrades to retry-with-backoff only (no
+probe, no reshard) — the non-mesh backends use it so every batch path
+shares one retry discipline.
+
+jax is imported lazily (inside methods), keeping
+``tuplewise_tpu.parallel`` importable for numpy-only use.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+class HealExhaustedError(RuntimeError):
+    """The device pool can no longer sustain the required mesh width —
+    resume the job from its checkpoint on a healthy pool instead."""
+
+
+class Backoff:
+    """Bounded exponential backoff with deterministic seeded jitter.
+
+    ``delay_s(attempt)`` (1-based) is ``base_s * 2**(attempt-1)``
+    capped at ``cap_s``, stretched by up to ``jitter`` fraction drawn
+    from a seeded generator — deterministic per instance, decorrelated
+    across instances with different seeds (retry storms from many
+    workers must not re-synchronize on the failed resource).
+    """
+
+    def __init__(self, base_s: float = 0.02, cap_s: float = 1.0,
+                 jitter: float = 0.25, seed: int = 0):
+        if base_s < 0 or cap_s < 0:
+            raise ValueError(f"backoff times must be >= 0: "
+                             f"base_s={base_s}, cap_s={cap_s}")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.jitter = jitter
+        self._rng = np.random.default_rng(seed)
+
+    def delay_s(self, attempt: int) -> float:
+        if attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {attempt}")
+        d = min(self.base_s * (2.0 ** (attempt - 1)), self.cap_s)
+        if self.jitter:
+            d *= 1.0 + self.jitter * float(self._rng.random())
+        return d
+
+    def sleep(self, attempt: int) -> None:
+        time.sleep(self.delay_s(attempt))
+
+
+class MeshHealer:
+    """Probe → reshard over survivors → re-place → backoff → retry.
+
+    Args:
+      mesh: the mesh to heal, or None for retry-with-backoff only.
+      fixed_width: keep the mesh at exactly this many workers across
+        reshards, backfilling lost slots from ``pool`` (trainers and
+        Monte-Carlo, whose semantics bake in the logical width); None
+        shrinks to the survivors (the serving index, whose counts are
+        width-invariant).
+      pool: devices eligible for rebuilds (default: the mesh's own
+        devices — shrink-only). Pass ``jax.devices()`` to let a
+        reshard use spare chips outside the original mesh.
+      chaos: a ``testing.chaos.FaultInjector`` whose ``take_dropped()``
+        supplies the dead-worker set a scheduled fault declared, in
+        place of a real probe (deterministic failure topology on a
+        healthy CPU mesh).
+      probe_timeout_s: wall-clock bound on the health probe (a hung
+        device must not hang the healer).
+      metrics: a ``utils.profiling.MetricsRegistry`` to record
+        ``reshard_events`` / ``shard_retries_total`` /
+        ``recovery_time_s`` into (create-or-return, so the serving
+        index shares its registry); None = a private one.
+      backoff: a :class:`Backoff`; None = defaults.
+    """
+
+    def __init__(self, mesh=None, *, fixed_width: Optional[int] = None,
+                 pool: Optional[Sequence] = None, chaos=None,
+                 probe_timeout_s: float = 5.0, metrics=None,
+                 backoff: Optional[Backoff] = None):
+        from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+        if fixed_width is not None and mesh is None:
+            raise ValueError("fixed_width needs a mesh to keep at width")
+        self.mesh = mesh
+        self.fixed_width = fixed_width
+        self.chaos = chaos
+        self.probe_timeout_s = probe_timeout_s
+        self.backoff = backoff if backoff is not None else Backoff()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._c_reshard = self.metrics.counter("reshard_events")
+        self._c_retries = self.metrics.counter("shard_retries_total")
+        self._h_recovery = self.metrics.histogram("recovery_time_s")
+        if mesh is not None:
+            devices = list(mesh.devices.flat)
+            self._pool = list(pool) if pool is not None else devices
+            if fixed_width is not None and len(devices) != fixed_width:
+                raise ValueError(
+                    f"fixed_width={fixed_width} but the mesh has "
+                    f"{len(devices)} devices")
+        else:
+            self._pool = []
+
+    # ------------------------------------------------------------------ #
+    @property
+    def n_workers(self) -> Optional[int]:
+        if self.mesh is None:
+            return None
+        return int(np.prod(self.mesh.devices.shape))
+
+    @property
+    def reshard_events(self) -> int:
+        return self._c_reshard.value
+
+    @property
+    def retries_total(self) -> int:
+        return self._c_retries.value
+
+    # ------------------------------------------------------------------ #
+    def _probe_dropped(self) -> tuple:
+        """Dead-worker set: the chaos schedule's declared topology when
+        one is pending, else a real (wall-clock-bounded) mesh probe."""
+        dropped = self.chaos.take_dropped() if self.chaos is not None \
+            else None
+        if dropped is not None:
+            return tuple(dropped)
+        from tuplewise_tpu.parallel.faults import detect_dropped_workers
+
+        try:
+            return detect_dropped_workers(
+                self.mesh, timeout_s=self.probe_timeout_s)
+        except Exception:
+            # the detector itself failed (all devices unreachable, or
+            # the probe machinery died): retry on the same mesh — if
+            # the fault was transient the retry succeeds, else the
+            # retry bound surfaces the original error
+            return ()
+
+    def _reshard(self) -> bool:
+        """Probe and rebuild the mesh; True when the mesh changed.
+        Raises :class:`HealExhaustedError` when nothing is left to
+        rebuild over (or the pool can't sustain ``fixed_width``)."""
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        dropped = self._probe_dropped()
+        if not dropped:
+            return False
+        dead = {self.mesh.devices.flat[int(w)] for w in dropped
+                if 0 <= int(w) < self.mesh.devices.size}
+        self._pool = [d for d in self._pool if d not in dead]
+        if self.fixed_width is not None:
+            if len(self._pool) < self.fixed_width:
+                raise HealExhaustedError(
+                    f"device pool ({len(self._pool)} alive) can no "
+                    f"longer sustain the mesh width {self.fixed_width}; "
+                    "resume from the checkpoint on a healthy pool")
+            new_devices = self._pool[: self.fixed_width]
+        else:
+            new_devices = [d for d in self.mesh.devices.flat
+                           if d not in dead]
+            if not new_devices:
+                raise HealExhaustedError(
+                    "every mesh device failed; nothing to reshard over")
+        self.mesh = make_mesh(len(new_devices), devices=new_devices)
+        return True
+
+    def heal(self, attempt: int,
+             on_heal: Optional[Callable] = None) -> bool:
+        """One recovery round: probe/reshard, let the caller re-place
+        (``on_heal(self)`` — device buffers may be torn even when the
+        mesh itself survived, so re-placement is unconditional), record
+        the recovery, back off. Returns True when the mesh changed."""
+        changed = False
+        if self.mesh is not None:
+            t0 = time.perf_counter()
+            changed = self._reshard()
+            if on_heal is not None:
+                on_heal(self)
+            self._c_reshard.inc()
+            self._h_recovery.observe(time.perf_counter() - t0)
+        elif on_heal is not None:
+            on_heal(self)
+        self.backoff.sleep(attempt)
+        return changed
+
+    def run(self, fn: Callable[[], object], *, retries: int = 3,
+            on_heal: Optional[Callable] = None):
+        """Execute ``fn()`` under the heal-and-retry protocol: on
+        failure, heal (probe → reshard → ``on_heal`` re-placement →
+        backoff) and retry, at most ``retries`` times — persistent
+        failure re-raises rather than spinning. ``HealExhaustedError``
+        propagates immediately (retrying cannot help)."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except HealExhaustedError:
+                raise
+            except Exception:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                self._c_retries.inc()
+                self.heal(attempt, on_heal=on_heal)

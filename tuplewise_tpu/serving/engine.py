@@ -99,6 +99,12 @@ class ServingConfig:
     snapshot_dir: Optional[str] = None  # crash-safe snapshots + event WAL
     snapshot_every: int = 4096     # events between snapshots
     recover: bool = False          # restore snapshot_dir state on start
+    # WAL durability [ISSUE 4 satellite]: "snapshot" (default) flushes
+    # every append past the process boundary (survives SIGKILL) and
+    # fsyncs only when a snapshot lands — a power loss can drop the
+    # tail since the last snapshot; "batch" fsyncs every append,
+    # closing that window at per-batch fsync latency (DESIGN §9).
+    wal_fsync: str = "snapshot"
     seed: int = 0
 
     def __post_init__(self):
@@ -115,6 +121,10 @@ class ServingConfig:
                 f"snapshot_every must be >= 1: {self.snapshot_every}")
         if self.recover and not self.snapshot_dir:
             raise ValueError("recover=True needs snapshot_dir")
+        if self.wal_fsync not in ("snapshot", "batch"):
+            raise ValueError(
+                f"wal_fsync must be 'snapshot' or 'batch': "
+                f"{self.wal_fsync!r}")
 
 
 class _Request:
@@ -187,7 +197,8 @@ class MicroBatchEngine:
             from tuplewise_tpu.serving.recovery import RecoveryManager
 
             self._recovery = RecoveryManager(
-                config.snapshot_dir, snapshot_every=config.snapshot_every)
+                config.snapshot_dir, snapshot_every=config.snapshot_every,
+                wal_fsync=config.wal_fsync)
             if config.recover:
                 self._recovery.recover(self)
             else:

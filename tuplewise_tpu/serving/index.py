@@ -58,9 +58,10 @@ interleaving.
 
 **Fault tolerance** [ISSUE 3]: the host is authoritative for the base
 runs — the device shards are a pure cache — so a dead/hung mesh device
-is survivable: a failed sharded count probes the mesh
-(``parallel.faults``), re-places the runs over the surviving devices,
-and retries with bounded backoff (``reshard_events`` /
+is survivable: a failed sharded count runs the shared heal-and-retry
+protocol (``parallel.self_heal.MeshHealer``, factored out in ISSUE 4
+so the batch path shares it): probe the mesh, re-place the runs over
+the surviving devices, retry with bounded backoff (``reshard_events`` /
 ``recovery_time_s`` metrics; bit-identical counts by additivity). A
 crashed background build rolls back its snapshot claim (the statistic
 is untouched — compaction never writes wins2) and a watchdog restarts
@@ -255,11 +256,26 @@ class ExactAucIndex:
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._c_compactions = self.metrics.counter("compactions_total")
         self._h_pause = self.metrics.histogram("compaction_pause_s")
-        # fault-tolerance observability [ISSUE 3]
-        self._c_reshard = self.metrics.counter("reshard_events")
-        self._c_retries = self.metrics.counter("shard_retries_total")
-        self._h_recovery = self.metrics.histogram("recovery_time_s")
+        # fault-tolerance observability [ISSUE 3]: the reshard/retry/
+        # recovery counters are registered here (create-or-return) so
+        # snapshots carry them even before any healer exists, and the
+        # shared healer below records into the SAME objects
+        self.metrics.counter("reshard_events")
+        self.metrics.counter("shard_retries_total")
+        self.metrics.histogram("recovery_time_s")
         self._c_bg_restarts = self.metrics.counter("bg_compactor_restarts")
+        # the heal-and-retry protocol now lives in parallel.self_heal
+        # [ISSUE 4] — one implementation for serving AND the batch
+        # path; shrink policy (fixed_width=None): counts are additive
+        # over any partition, so a narrower mesh stays bit-identical
+        self._healer = None
+        if shards is not None:
+            from tuplewise_tpu.parallel.self_heal import Backoff, MeshHealer
+
+            self._healer = MeshHealer(
+                self._mesh, chaos=chaos,
+                probe_timeout_s=probe_timeout_s, metrics=self.metrics,
+                backoff=Backoff(base_s=retry_backoff_s, cap_s=1.0))
         # one re-entrant lock guards ALL container structure; the
         # condition signals build completion (compact() drains on it).
         # Synchronous mode takes the same (uncontended) lock — one code
@@ -306,56 +322,30 @@ class ExactAucIndex:
         """Sharded counts with bounded self-healing retries [ISSUE 3].
 
         A device failure surfaces as the count call raising. The host
-        is authoritative for the merged base runs, so recovery is:
-        probe which workers are dead, rebuild the mesh over the
-        survivors, re-place BOTH sides' base runs, back off, retry —
-        the re-placed counts are bit-identical (counting is additive
-        over any partition), so a healed query returns exactly what the
-        healthy mesh would have.
+        is authoritative for the merged base runs, so recovery
+        (``parallel.self_heal.MeshHealer``) is: probe which workers are
+        dead, rebuild the mesh over the survivors, re-place BOTH sides'
+        base runs, back off, retry — the re-placed counts are
+        bit-identical (counting is additive over any partition), so a
+        healed query returns exactly what the healthy mesh would have.
         """
         from tuplewise_tpu.parallel.sharded_counts import sharded_counts
 
-        attempt = 0
-        while True:
-            try:
-                return sharded_counts(self._mesh, side.base_dev, side.cap,
-                                      q, self.dtype, chaos=self.chaos)
-            except Exception:
-                attempt += 1
-                if attempt > self.shard_retries:
-                    raise
-                self._c_retries.inc()
-                self._heal_mesh(attempt)
+        def attempt():
+            return sharded_counts(self._mesh, side.base_dev, side.cap,
+                                  q, self.dtype, chaos=self.chaos)
 
-    def _heal_mesh(self, attempt: int) -> None:
-        """Probe -> reshard over survivors -> re-place -> back off."""
-        from tuplewise_tpu.parallel.faults import detect_dropped_workers
-        from tuplewise_tpu.parallel.mesh import make_mesh
+        return self._healer.run(attempt, retries=self.shard_retries,
+                                on_heal=self._on_heal)
 
-        t0 = time.perf_counter()
-        dropped = self.chaos.take_dropped() if self.chaos is not None \
-            else None
-        if dropped is None:
-            try:
-                dropped = detect_dropped_workers(
-                    self._mesh, timeout_s=self.probe_timeout_s)
-            except Exception:
-                # the detector itself failed (all devices unreachable,
-                # or the probe machinery died): retry on the same mesh
-                # — if the fault was transient the retry succeeds, else
-                # the retry bound surfaces the original error
-                dropped = ()
-        if dropped:
-            alive = [d for i, d in enumerate(self._mesh.devices.flat)
-                     if i not in set(dropped)]
-            self._mesh = make_mesh(devices=alive)
-            self.shards = len(alive)
-        # re-place from the host-authoritative runs (pure cache rebuild)
+    def _on_heal(self, healer) -> None:
+        """Re-placement after a heal round: adopt the (possibly
+        resharded) mesh and rebuild the device shards from the
+        host-authoritative runs (pure cache rebuild)."""
+        self._mesh = healer.mesh
+        self.shards = healer.n_workers
         self._place(self._pos)
         self._place(self._neg)
-        self._c_reshard.inc()
-        self._h_recovery.observe(time.perf_counter() - t0)
-        time.sleep(min(self.retry_backoff_s * (2 ** (attempt - 1)), 1.0))
 
     def _counts(self, side: _ClassSide,
                 q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

@@ -1,5 +1,5 @@
 """Crash-safe serving recovery: atomic snapshots + a replayable event
-tail [ISSUE 3].
+tail [ISSUE 3; snapshot writes moved off the batcher thread in ISSUE 4].
 
 The exact index is pure deterministic state: wins2 and the containers
 are a function of the admitted event sequence, independent of batching
@@ -9,15 +9,37 @@ decomposes into two durable artifacts:
 * **Snapshot** — a single-file ``.npz`` of the full estimator state
   (base runs, buffers, tombstones, arrival log, wins2 as a decimal
   string — it is an unbounded Python int — plus the incomplete-U sums,
-  reservoirs, and host RNG state), written through
-  ``utils.checkpoint.save_checkpoint`` (fsync'd temp + atomic rename:
-  a snapshot either exists completely or not at all).
+  reservoirs, and host RNG state via ``utils.rng.capture_np_rng``),
+  written through ``utils.checkpoint.save_checkpoint`` (fsync'd temp +
+  atomic rename: a snapshot either exists completely or not at all).
 * **WAL** — an append-only JSONL write-ahead log of admitted insert
   batches, flushed to the OS before the batch is applied. A SIGKILL
   cannot lose an admitted event: file data written via ``write()``
-  survives process death. Each entry carries its absolute event
-  sequence number, so replay after a snapshot at seq S skips entries
-  below S — truncation racing a crash is harmless.
+  survives process death. ``wal_fsync="batch"`` additionally fsyncs
+  every append, extending the guarantee to machine power loss at
+  per-batch latency cost (the documented trade of DESIGN §9; the
+  default ``"snapshot"`` fsyncs durable state only when a snapshot
+  lands). Each entry carries its absolute event sequence number, so
+  replay after a snapshot at seq S skips entries below S — pruning
+  racing a crash is harmless.
+
+**Snapshot writes are asynchronous** [ISSUE 4 satellite]: the batcher
+thread only *captures* the state (host-array copies under the engine
+lock — the atomic handoff) and *seals* the live WAL into a segment
+file; the expensive part — ``np.savez`` + fsync + rename — runs on a
+side writer thread, so inserts proceed during a slow snapshot. The WAL
+is segment-structured to make that safe under concurrent appends:
+
+    events.wal              — the live log (appends land here)
+    events.wal.upto<SEQ>    — sealed segments; every entry's seq < SEQ
+
+At capture time (seq = S) the live log is sealed as ``upto S`` and a
+fresh live log opened; once the snapshot at S durably lands, the
+writer deletes every segment whose name-seq <= S (their entries are
+all inside the snapshot). A crash at ANY point leaves snapshot +
+segments + live log that replay back to the exact pre-crash state:
+replay walks segments in seq order, then the live log, skipping
+entries below the snapshot's seq.
 
 Recovery = restore the snapshot, replay the tail. Both operations are
 bit-exact: wins2 round-trips through its decimal string, scores
@@ -33,23 +55,34 @@ from __future__ import annotations
 import collections
 import json
 import os
-from typing import Iterator, Optional, Tuple
+import queue
+import threading
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from tuplewise_tpu.utils.checkpoint import (
     check_config, load_checkpoint, save_checkpoint,
 )
+from tuplewise_tpu.utils.rng import capture_np_rng, restore_np_rng
 
 SNAPSHOT_FILE = "snapshot.npz"
 WAL_FILE = "events.wal"
+_SEG_SEP = ".upto"
 
 
 class EventLog:
-    """Append-only JSONL WAL of admitted insert batches."""
+    """Append-only JSONL WAL of admitted insert batches.
 
-    def __init__(self, path: str):
+    ``fsync=True`` (``wal_fsync="batch"``) forces every append to disk
+    — durable against power loss, at per-batch fsync latency; the
+    default flush-only append survives process death (SIGKILL) but
+    rides the page cache.
+    """
+
+    def __init__(self, path: str, fsync: bool = False):
         self.path = path
+        self.fsync = fsync
         self._f = open(path, "a", encoding="utf-8")
 
     def append(self, seq: int, scores: np.ndarray,
@@ -58,19 +91,49 @@ class EventLog:
                "s": [float(x) for x in scores],
                "l": [int(bool(x)) for x in labels]}
         self._f.write(json.dumps(rec) + "\n")
-        # flush past the process boundary: survives SIGKILL (os.fsync
-        # would additionally survive power loss, at per-batch cost —
-        # the snapshot path IS fsync'd, so a machine crash loses at
-        # most the tail since the last snapshot)
+        # flush past the process boundary: survives SIGKILL; fsync
+        # additionally survives power loss (wal_fsync="batch")
         self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+
+    def seal(self, upto_seq: int) -> str:
+        """Rotate the live log aside as an immutable segment holding
+        only entries with seq < ``upto_seq``, and reopen a fresh live
+        log. Called by the snapshot capture (batcher thread) so the
+        async writer can later delete exactly the entries the landed
+        snapshot covers, while new appends keep flowing."""
+        self._f.close()
+        seg = f"{self.path}{_SEG_SEP}{int(upto_seq):020d}"
+        os.replace(self.path, seg)
+        self._f = open(self.path, "w", encoding="utf-8")
+        return seg
 
     def truncate(self) -> None:
-        """Start a fresh log (called right after a snapshot lands)."""
+        """Start a fresh live log (synchronous-snapshot path: every
+        entry is already inside the snapshot that just landed)."""
         self._f.close()
         self._f = open(self.path, "w", encoding="utf-8")
 
     def close(self) -> None:
         self._f.close()
+
+    @staticmethod
+    def segments(path: str) -> List[Tuple[int, str]]:
+        """Sealed (seq, segment_path) pairs for a live-log path, in
+        ascending seq order."""
+        d, name = os.path.split(path)
+        prefix = name + _SEG_SEP
+        out = []
+        for fn in os.listdir(d or "."):
+            if not fn.startswith(prefix):
+                continue
+            try:
+                seq = int(fn[len(prefix):])
+            except ValueError:
+                continue
+            out.append((seq, os.path.join(d, fn)))
+        return sorted(out)
 
     @staticmethod
     def replay(path: str) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
@@ -91,6 +154,14 @@ class EventLog:
                        np.asarray(rec["s"], dtype=np.float64),
                        np.asarray(rec["l"], dtype=bool))
 
+    @staticmethod
+    def replay_all(path: str) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
+        """Replay sealed segments (seq order) then the live log — the
+        full surviving tail regardless of where a crash landed."""
+        for _, seg in EventLog.segments(path):
+            yield from EventLog.replay(seg)
+        yield from EventLog.replay(path)
+
 
 def _compat_config(config) -> dict:
     """The config keys a snapshot must agree on to be resumable —
@@ -103,14 +174,21 @@ def _compat_config(config) -> dict:
     }
 
 
-def save_snapshot(directory: str, *, seq: int, engine) -> None:
-    """Capture the engine's full estimator state atomically."""
+def capture_snapshot_state(engine) -> Tuple[dict, dict]:
+    """The atomic handoff [ISSUE 4 satellite]: copy the engine's full
+    estimator state into host arrays (cheap — no serialization, no
+    disk) and return (extra, cfg) for a writer to persist. Runs on the
+    batcher thread under the engine lock, so the capture is a
+    consistent cut at the current event seq."""
     extra = {}
     cfg = dict(_compat_config(engine.config))
     idx = engine.index
     if idx is not None:
         with idx._cv:
             for name, side in (("pos", idx._pos), ("neg", idx._neg)):
+                # base arrays are rebound, never mutated in place
+                # (compaction swaps a NEW merged array in), so aliasing
+                # is a consistent capture with no O(n) copy
                 extra[f"{name}_base"] = np.asarray(side.base,
                                                    dtype=idx.dtype)
                 extra[f"{name}_buf"] = np.asarray(side.buf,
@@ -135,9 +213,21 @@ def save_snapshot(directory: str, *, seq: int, engine) -> None:
         extra[f"{name}_items"] = res.items[: res.size].copy()
         extra[f"{name}_meta"] = np.asarray([res.size, res.seen],
                                            dtype=np.int64)
-    cfg["rng_state"] = st._rng.bit_generator.state
+    cfg["rng_state"] = capture_np_rng(st._rng)
+    return extra, cfg
+
+
+def write_snapshot(directory: str, *, seq: int, extra: dict,
+                   cfg: dict) -> None:
+    """Persist a captured state atomically (fsync'd temp + rename)."""
     save_checkpoint(os.path.join(directory, SNAPSHOT_FILE),
                     step=seq, extra=extra, config=cfg)
+
+
+def save_snapshot(directory: str, *, seq: int, engine) -> None:
+    """Capture + write in one (synchronous) call."""
+    extra, cfg = capture_snapshot_state(engine)
+    write_snapshot(directory, seq=seq, extra=extra, cfg=cfg)
 
 
 def restore_snapshot(directory: str, engine) -> Optional[int]:
@@ -176,22 +266,43 @@ def restore_snapshot(directory: str, engine) -> Optional[int]:
         size, seen = (int(x) for x in extra[f"{name}_meta"])
         res.items[:size] = extra[f"{name}_items"]
         res.size, res.seen = size, seen
-    st._rng.bit_generator.state = cfg["rng_state"]
+    restore_np_rng(st._rng, cfg["rng_state"])
     return int(ck["step"])
 
 
 class RecoveryManager:
-    """Owns a recovery directory: the WAL, the snapshot cadence, and
-    the recover-on-start protocol. One per engine; all calls arrive on
-    the batcher thread (or before the worker starts), so no lock."""
+    """Owns a recovery directory: the WAL, the snapshot cadence, the
+    async writer, and the recover-on-start protocol. One per engine;
+    capture/record calls arrive on the batcher thread (or before the
+    worker starts) — the internal lock only coordinates with the side
+    writer thread."""
 
-    def __init__(self, directory: str, snapshot_every: int = 4096):
+    def __init__(self, directory: str, snapshot_every: int = 4096,
+                 wal_fsync: str = "snapshot",
+                 snapshot_async: bool = True):
+        if wal_fsync not in ("snapshot", "batch"):
+            raise ValueError(
+                f"wal_fsync must be 'snapshot' or 'batch': {wal_fsync!r}")
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
         self.snapshot_every = snapshot_every
+        self.wal_fsync = wal_fsync
+        self.snapshot_async = snapshot_async
         self._wal: Optional[EventLog] = None
         self._seq = 0
         self._since_snapshot = 0
+        self._lock = threading.Lock()
+        self._inflight = False          # one async write at a time
+        self._jobs: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._writer: Optional[threading.Thread] = None
+        self.last_snapshot_error: Optional[str] = None
+        self._write_test_hook = None    # tests: called before the write
+
+    def _wal_path(self) -> str:
+        return os.path.join(self.directory, WAL_FILE)
+
+    def _open_wal(self) -> EventLog:
+        return EventLog(self._wal_path(), fsync=self.wal_fsync == "batch")
 
     # ------------------------------------------------------------------ #
     def start_fresh(self) -> None:
@@ -200,14 +311,16 @@ class RecoveryManager:
         snap = os.path.join(self.directory, SNAPSHOT_FILE)
         if os.path.exists(snap):
             os.unlink(snap)
-        self._wal = EventLog(os.path.join(self.directory, WAL_FILE))
+        for _, seg in EventLog.segments(self._wal_path()):
+            os.unlink(seg)
+        self._wal = self._open_wal()
         self._wal.truncate()
 
     def recover(self, engine) -> int:
-        """Snapshot + tail replay; returns the recovered event seq."""
+        """Snapshot + tail replay (sealed segments, then the live
+        log); returns the recovered event seq."""
         seq = restore_snapshot(self.directory, engine) or 0
-        for s0, scores, labels in EventLog.replay(
-                os.path.join(self.directory, WAL_FILE)):
+        for s0, scores, labels in EventLog.replay_all(self._wal_path()):
             if s0 < seq:
                 continue    # already inside the snapshot
             if engine.index is not None:
@@ -215,7 +328,7 @@ class RecoveryManager:
             engine.streaming.extend(scores, labels)
             seq = s0 + len(scores)
         self._seq = seq
-        self._wal = EventLog(os.path.join(self.directory, WAL_FILE))
+        self._wal = self._open_wal()
         return seq
 
     # ------------------------------------------------------------------ #
@@ -225,24 +338,102 @@ class RecoveryManager:
         self._since_snapshot += len(scores)
 
     def maybe_snapshot(self, engine) -> None:
-        if self._since_snapshot >= self.snapshot_every:
+        if self._since_snapshot < self.snapshot_every:
+            return
+        if not self.snapshot_async:
             self.snapshot(engine)
+            return
+        with self._lock:
+            if self._inflight:
+                # a slow write is still landing: keep serving (and keep
+                # accruing _since_snapshot); the next insert after it
+                # lands triggers the capture
+                return
+            self._inflight = True
+        # the atomic handoff: capture host copies + seal the live WAL
+        # on this (batcher) thread — cheap; the np.savez + fsync +
+        # rename runs on the writer thread
+        seq = self._seq
+        extra, cfg = capture_snapshot_state(engine)
+        self._wal.seal(seq)
+        self._since_snapshot = 0
+        self._ensure_writer()
+        self._jobs.put((seq, extra, cfg))
 
     def snapshot(self, engine) -> None:
-        save_snapshot(self.directory, seq=self._seq, engine=engine)
+        """Synchronous capture + write (close path, and the
+        ``snapshot_async=False`` escape hatch)."""
+        extra, cfg = capture_snapshot_state(engine)
+        write_snapshot(self.directory, seq=self._seq, extra=extra,
+                       cfg=cfg)
+        self._prune_segments(self._seq)
         # safe to prune only AFTER the snapshot atomically landed; a
         # crash in between leaves WAL entries below seq, which replay
         # skips
         self._wal.truncate()
         self._since_snapshot = 0
 
+    # ------------------------------------------------------------------ #
+    # side writer thread [ISSUE 4 satellite]                             #
+    # ------------------------------------------------------------------ #
+    def _ensure_writer(self) -> None:
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._write_worker, name="tuplewise-snapshotter",
+                daemon=True)
+            self._writer.start()
+
+    def _write_worker(self) -> None:
+        while True:
+            job = self._jobs.get()
+            try:
+                if job is None:
+                    return
+                seq, extra, cfg = job
+                try:
+                    if self._write_test_hook is not None:
+                        self._write_test_hook(seq)
+                    write_snapshot(self.directory, seq=seq, extra=extra,
+                                   cfg=cfg)
+                    self._prune_segments(seq)
+                except BaseException as e:   # noqa: BLE001 — kept, not raised
+                    # a failed write loses nothing: the sealed segments
+                    # it would have pruned still replay over the OLD
+                    # snapshot; record the error for stats()/operators
+                    self.last_snapshot_error = repr(e)
+            finally:
+                with self._lock:
+                    self._inflight = False
+                self._jobs.task_done()
+
+    def _prune_segments(self, landed_seq: int) -> None:
+        """Delete sealed segments fully covered by the snapshot that
+        just landed (name-seq <= landed seq: every entry is < it)."""
+        for seq, seg in EventLog.segments(self._wal_path()):
+            if seq <= landed_seq:
+                try:
+                    os.unlink(seg)
+                except OSError:
+                    pass    # already pruned (or raced a fresh start)
+
+    def _drain_writer(self) -> None:
+        """Block until every queued async write has landed (or
+        failed) — ordering guard so a final synchronous snapshot can
+        never be overwritten by an older async one."""
+        if self._writer is not None:
+            self._jobs.join()
+
     def checkpoint_and_close(self, engine) -> None:
-        """Graceful shutdown: one final snapshot so restart is
-        tail-free, then release the WAL handle."""
+        """Graceful shutdown: drain the async writer, take one final
+        snapshot so restart is tail-free, then release the WAL."""
         if self._wal is None:
             return
+        self._drain_writer()
         if self._since_snapshot:
             self.snapshot(engine)
+        if self._writer is not None and self._writer.is_alive():
+            self._jobs.put(None)
+            self._writer.join(timeout=10.0)
         self._wal.close()
         self._wal = None
 

@@ -1,4 +1,5 @@
-"""Deterministic chaos injection for the serving stack [ISSUE 3].
+"""Deterministic chaos injection for the serving stack [ISSUE 3] and
+the batch (training/estimation) path [ISSUE 4].
 
 The offline estimators are *naturally* tolerant to worker loss
 (``parallel/faults.py``: drop-and-renormalize), but the online serving
@@ -6,7 +7,9 @@ path recovers by **repairing state**, not by renormalizing — and repair
 code that only runs when hardware dies is code that never runs in CI.
 This module makes failures a first-class, reproducible input: a seeded
 ``FaultInjector`` carries a schedule of faults keyed to named hook
-points that the serving stack fires as it executes —
+points that the stack fires as it executes —
+
+serving points (ISSUE 3):
 
     ``sharded_count``   — the mesh count query in
                           ``parallel.sharded_counts`` (a raise here is
@@ -18,10 +21,30 @@ points that the serving stack fires as it executes —
     ``poison``          — event corruption (NaN/inf scores) applied to
                           the stream by ``serving/replay.py``.
 
+batch-path points (ISSUE 4):
+
+    ``train_step``      — one SGD scan chunk in
+                          ``models/pairwise_sgd.py`` / ``triplet_sgd``;
+    ``mc_chunk``        — one Monte-Carlo chunk in
+                          ``harness/variance.run_variance_experiment``;
+    ``mesh_mc``         — one dispatch of the compiled mesh Monte-Carlo
+                          program (``harness/mesh_mc.py``);
+    ``estimator``       — one Estimator scheme call
+                          (``estimators/estimator.py``);
+    ``checkpoint``      — fired right AFTER a checkpoint lands (the
+                          ``sigkill`` action here is deterministic
+                          preemption: die with durable state at a known
+                          step);
+    ``dist_init``       — multi-process bring-up
+                          (``parallel/distributed.initialize``).
+
 Each schedule entry names its point, the 1-based call number at which
-it fires, and an action (``error`` raises, ``delay`` sleeps). A
-``sharded_count`` fault may also declare the worker ids a paired health
-probe should report dead (``dropped``), so the self-healing path can be
+it fires, and an action (``error`` raises, ``delay`` sleeps,
+``sigkill`` SIGKILLs the whole process — the real preemption signal,
+not an exception anything can catch). A mesh-facing fault
+(``sharded_count``, ``mesh_mc``, ``train_step``, ``mc_chunk``,
+``estimator``) may also declare the worker ids a paired health probe
+should report dead (``dropped``), so the self-healing path can be
 driven through a *specific* failure topology on a healthy CPU mesh.
 
 Everything is deterministic given the spec (and ``FaultInjector.random``
@@ -37,14 +60,18 @@ All hooks are no-ops when no injector is attached: production pays one
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-_POINTS = ("sharded_count", "compactor_build", "batcher", "place_base")
-_ACTIONS = ("error", "delay")
+_POINTS = ("sharded_count", "compactor_build", "batcher", "place_base",
+           "train_step", "mc_chunk", "mesh_mc", "estimator",
+           "checkpoint", "dist_init")
+_ACTIONS = ("error", "delay", "sigkill")
 
 
 class InjectedFault(RuntimeError):
@@ -183,11 +210,19 @@ class FaultInjector:
                     self._pending_dropped = f.dropped
             delay = sum(f.seconds for f in due if f.action == "delay")
             errors = [f for f in due if f.action == "error"]
+            kills = [f for f in due if f.action == "sigkill"]
         if delay > 0:
             time.sleep(delay)
+        if kills:
+            # real preemption: the process dies HERE, uncatchably —
+            # recovery is whatever the durable state (checkpoint/WAL)
+            # plus a --resume restart can reconstruct
+            os.kill(os.getpid(), signal.SIGKILL)
         if errors:
             exc = (InjectedDeviceError if point in
-                   ("sharded_count", "place_base") else InjectedFault)
+                   ("sharded_count", "place_base", "mesh_mc",
+                    "train_step", "mc_chunk", "estimator")
+                   else InjectedFault)
             raise exc(
                 f"chaos: injected {point} fault (call #{errors[0].on_call})")
 

@@ -136,6 +136,33 @@ def iter_chunks(start: int, total: int, every: Optional[int]):
         m += c
 
 
+def prepare_resume(path: Optional[str], resume: bool) -> None:
+    """CLI ``--resume`` discipline [ISSUE 4]: without ``--resume`` an
+    existing checkpoint file is removed (a fresh run), so a stale file
+    from an earlier experiment can never silently turn a new run into a
+    continuation. With ``--resume`` the file is left for
+    :func:`resume_progress` (which still validates the stored config).
+    Library callers keep auto-resume semantics by not calling this."""
+    if path and not resume and os.path.exists(path):
+        os.unlink(path)
+
+
+def params_digest(params: Dict[str, Any]) -> str:
+    """Order-independent SHA-256 of a params dict — the cheap
+    bit-identity witness the preemption smoke and resume tests compare
+    across processes (equal digests <=> equal bytes in every array)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for name in sorted(params):
+        arr = np.ascontiguousarray(np.asarray(params[name]))
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def check_config(
     stored: Optional[dict], requested: dict, *, ignore: tuple = ()
 ) -> None:

@@ -87,6 +87,27 @@ def _record_fold(key, purpose, indices) -> None:
     seen.add(chain)
 
 
+# --------------------------------------------------------------------- #
+# mutable host-RNG state capture [ISSUE 4]                               #
+# --------------------------------------------------------------------- #
+# The JAX paths need no state capture — every key folds from absolute
+# indices, so a resumed run re-derives its randomness. Host-side
+# mutable generators (serving reservoirs, backoff jitter) DO carry
+# state; these two helpers are the one place that knows how to
+# round-trip it exactly (the bit_generator state dict is plain ints/
+# strings, so it survives the JSON config block of a checkpoint).
+
+def capture_np_rng(gen) -> dict:
+    """JSON-safe snapshot of a ``numpy.random.Generator``'s full state."""
+    return gen.bit_generator.state
+
+
+def restore_np_rng(gen, state: dict) -> None:
+    """Restore a state captured by :func:`capture_np_rng` — the
+    generator continues the original stream bit-for-bit."""
+    gen.bit_generator.state = state
+
+
 @contextlib.contextmanager
 def audit_keys():
     """``with audit_keys(): ...`` — raise on any repeated host-side fold
