@@ -19,7 +19,13 @@ closed loop (``--max-inflight``), so percentiles price per-event cost
 rather than queue backlog. ``--chaos`` [ISSUE 3] reruns the streaming
 bench under a seeded fault schedule (compactor crash, batcher crash,
 poison events) and adds the recovery counters + admitted-events parity
-to the record — throughput WITH failures, not just without.
+to the record — throughput WITH failures, not just without. The
+``delta_compaction`` cell [ISSUE 5] prices the sharded index's
+compaction byte budget: host→device bytes per minor compaction with
+delta runs + on-mesh major merges vs the PR 2 full re-placement, at
+n=10^6 and S=4 by default (``--delta-bench-n 0`` skips). With
+``--out``, the streaming record and the delta cell also land as JSONL
+rows (the perf-trajectory file ``results/serving.jsonl``).
 
 `value` is the complete-AUC pair-kernel throughput of the JAX/TPU tiled
 reduction on one chip (BASELINE.json:2's metric). The reference repo
@@ -222,6 +228,121 @@ def _numpy_pairs_per_sec(n=16384, reps=3):
     return (n * n) / dt
 
 
+def _delta_compaction_cell(n_events=1_000_000, shards=4,
+                           compact_every=1024, delta_fraction=0.25,
+                           max_delta_runs=64, chunk=128, seed=0):
+    """Bytes-shipped-per-compaction cell [ISSUE 5]: drive the SHARDED
+    index directly (no request queue — per-insert latency is the
+    index's own cost) through the same stream twice, delta compaction
+    vs the PR 2 host-merge + full-re-placement path, and report
+    host→device bytes per minor compaction plus insert-latency
+    percentiles. Both runs compact SYNCHRONOUSLY, so every tier bills
+    its true pause to the inserting thread — the honest apples-to-
+    apples cost of the two compaction strategies (the background
+    compactor's independent p99 win over sync mode is the main
+    streaming record's ``p99_insert_vs_sync_compact``). ``chunk``
+    defaults to the engine's TYPICAL coalesced micro-batch (~half of
+    ``max_batch=256`` at the measured ~0.25-0.5 mean batch fill), so
+    per-batch latency percentiles reflect what a serving batcher
+    dispatch actually pays. Returns None when the platform has fewer
+    than ``shards`` devices."""
+    import jax
+
+    from tuplewise_tpu.serving import ExactAucIndex
+    from tuplewise_tpu.serving.replay import make_stream
+
+    if jax.device_count() < shards:
+        print(f"[bench] delta cell skipped: {jax.device_count()} "
+              f"devices < {shards} shards", file=sys.stderr)
+        return None
+    scores, labels = make_stream(n_events, pos_frac=0.5,
+                                 separation=1.0, seed=seed)
+    scores = scores.astype(np.float32)
+    out = {"n_events": n_events, "shards": shards,
+           "compact_every": compact_every,
+           "delta_fraction": delta_fraction,
+           "max_delta_runs": max_delta_runs, "chunk": chunk}
+    wins = {}
+
+    def _drive(frac, record):
+        idx = ExactAucIndex(engine="jax", compact_every=compact_every,
+                            shards=shards, bg_compact=False,
+                            delta_fraction=frac,
+                            max_delta_runs=max_delta_runs)
+        lats = []
+        t_all = time.perf_counter()
+        for i in range(0, n_events, chunk):
+            t0 = time.perf_counter()
+            idx.insert_batch(scores[i:i + chunk], labels[i:i + chunk])
+            lats.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t_all
+        if not record:
+            idx.close()
+        return idx, lats, wall
+
+    for mode, frac in (("delta", delta_fraction), ("host_merge", 0.0)):
+        # warmup pass: the bucket-ladder kernels (multi-run counts,
+        # on-mesh merges) compile as the base grows — a long-lived
+        # service pays them once, so the timed pass measures steady
+        # state (same discipline as replay(warmup=True))
+        _drive(frac, record=False)
+        idx, lats, wall = _drive(frac, record=True)
+        snap = idx.metrics.snapshot()
+        cb = snap.get("compaction_bytes", {})
+        lat = np.asarray(lats) * 1e3
+        out[mode] = {
+            "wall_s": wall,
+            "events_per_s": n_events / wall,
+            "insert_latency_p50_ms": float(np.percentile(lat, 50)),
+            "insert_latency_p99_ms": float(np.percentile(lat, 99)),
+            "compactions": idx.n_compactions,
+            "minor_compactions": cb.get("count", 0),
+            "bytes_h2d": snap["bytes_h2d"]["value"],
+            "bytes_h2d_saved": snap["bytes_h2d_saved"]["value"],
+            "bytes_per_minor_compaction": cb.get("mean"),
+            "major_merges": snap["major_merges_total"]["value"],
+            "major_merge_fallbacks":
+                snap["major_merge_fallbacks"]["value"],
+            "major_merge_p99_ms": (
+                None if snap["major_merge_s"].get("p99") is None
+                else snap["major_merge_s"]["p99"] * 1e3),
+        }
+        wins[mode] = idx._wins2
+        idx.close()
+        print(
+            f"[bench] delta cell [{mode}]: "
+            f"{out[mode]['bytes_per_minor_compaction']:.0f} B/minor "
+            f"({out[mode]['minor_compactions']} minors, "
+            f"{out[mode]['major_merges']} majors), "
+            f"insert p99={out[mode]['insert_latency_p99_ms']:.2f}ms",
+            file=sys.stderr,
+        )
+    # the acceptance pair [ISSUE 5]: >= 10x fewer bytes per minor
+    # compaction, p99 insert no worse — and exact parity between modes
+    out["bytes_per_minor_ratio"] = round(
+        out["host_merge"]["bytes_per_minor_compaction"]
+        / out["delta"]["bytes_per_minor_compaction"], 1)
+    out["p99_insert_vs_host_merge"] = round(
+        out["host_merge"]["insert_latency_p99_ms"]
+        / out["delta"]["insert_latency_p99_ms"], 2)
+    out["p99_note"] = (
+        "CPU caveat: host==device silicon, so the host-merge path "
+        "pays no transfer penalty here and its O(n) per-minor cost "
+        "only overtakes the delta tiers' flat cost at n~2e6 on CPU "
+        "(p99 ratio crosses 1.0 there — run with "
+        "--delta-bench-n 2000000); on accelerators the O(n) "
+        "host->device re-ship dominates far earlier"
+    )
+    out["wins2_parity"] = wins["delta"] == wins["host_merge"]
+    print(
+        f"[bench] delta compaction: {out['bytes_per_minor_ratio']}x "
+        f"fewer bytes/minor, p99 ratio "
+        f"{out['p99_insert_vs_host_merge']}x, "
+        f"parity={out['wins2_parity']}", file=sys.stderr,
+    )
+    return out
+
+
 # Default --chaos schedule: one compactor crash, one batcher crash, and
 # a few poison events — the recovery paths a serving deploy actually
 # exercises, at bench scale. Shard death needs a multi-device mesh, so
@@ -386,7 +507,23 @@ def _streaming_main(args):
             "forced back onto the batcher thread — the pause the "
             "background compactor removes from the request path"
         )
+    if args.delta_bench_n:
+        # delta-compaction byte budget [ISSUE 5]: bytes shipped per
+        # minor compaction, delta mode vs the PR 2 full re-placement,
+        # at n=10^6 S=4 by default (the acceptance cell)
+        cell = _delta_compaction_cell(
+            n_events=args.delta_bench_n, shards=args.delta_bench_shards)
+        if cell is not None:
+            out["delta_compaction"] = cell
     print(json.dumps(out))
+    if args.out:
+        rows = [dict(out, stage="bench_streaming")]
+        if out.get("delta_compaction"):
+            rows.append(dict(out["delta_compaction"],
+                             stage="delta_compaction"))
+        with open(args.out, "a", encoding="utf-8") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
 
 
 def main():
@@ -408,6 +545,16 @@ def main():
     ap.add_argument("--sync-compact", action="store_true",
                     help="compact on the batcher thread (pre-PR2 "
                          "behavior); skips the sync comparison run")
+    ap.add_argument("--delta-bench-n", type=int, default=1_000_000,
+                    help="events for the delta-compaction byte cell "
+                         "(bytes/minor-compaction, delta vs host-merge "
+                         "mode, sharded index driven directly); 0 "
+                         "skips it")
+    ap.add_argument("--delta-bench-shards", type=int, default=4)
+    ap.add_argument("--out", type=str, default=None,
+                    help="with --streaming: also append the record "
+                         "(and the delta cell) as JSONL rows, e.g. "
+                         "results/serving.jsonl")
     ap.add_argument("--chaos", action="store_true",
                     help="run under a seeded fault schedule: with "
                          "--streaming, the serving schedule (compactor "

@@ -26,6 +26,19 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
+# Sharded delta-compaction smoke [ISSUE 5]: the same replay on a
+# 2-device mesh, delta mode vs the host-merge engine — asserts
+# bit-identical AUC between the two engines (and vs the single-host
+# index's integer wins), plus a strict host->device byte saving per
+# minor compaction; writes results/serving_smoke_sharded.jsonl.
+timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/streaming_smoke.py --mesh-shards 2 \
+    --delta-fraction 0.25 --n-events 6000 \
+    --out results/serving_smoke_sharded.jsonl
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 # Chaos smoke [ISSUE 3]: a seeded fault schedule (shard death +
 # compactor crash + batcher crash + poison events) through replay;
 # asserts every recovery counter fired and the final AUC is

@@ -454,9 +454,15 @@ def main():
         if jax.device_count() >= 4:
             # mesh-sharded index (per-shard searchsorted + psum'd win
             # counts) — needs >= 4 devices (TPU pod slice, or the
-            # 8-virtual-device CPU test config)
+            # 8-virtual-device CPU test config). Two cells [ISSUE 5]:
+            # delta compaction (the default) vs the host-merge
+            # full-re-placement engine — the rows' bytes_h2d /
+            # bytes_per_compaction fields record the transfer win.
             cells.insert(2, {"max_batch": 256, "budget": 64,
                              "bg_compact": True, "mesh_shards": 4})
+            cells.insert(3, {"max_batch": 256, "budget": 64,
+                             "bg_compact": True, "mesh_shards": 4,
+                             "delta_fraction": 0.0})
         p99s = {}
         for cell in cells:
             # low-latency regime (small flush window, 64 in flight):

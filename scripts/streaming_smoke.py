@@ -1,4 +1,5 @@
-"""CI streaming-latency smoke [ISSUE 2 satellite].
+"""CI streaming-latency smoke [ISSUE 2 satellite; sharded delta leg
+ISSUE 5].
 
 A fast end-to-end check of the serving path as CI sees it: replay a
 small stream through the micro-batch engine with background compaction
@@ -6,9 +7,19 @@ on, assert the latency-percentile fields are present and the exact
 estimate matches the batch oracle, and append the row (stage
 "ci_smoke") to a serving JSONL the workflow uploads as an artifact.
 
+With ``--mesh-shards`` the smoke exercises the SHARDED index's delta
+compaction instead: the same stream replays in delta mode and in the
+PR 2 host-merge mode, and the run fails unless (1) both modes' exact
+AUC is bit-identical (and a directly-driven delta index matches the
+single-host index's wins2 exactly), and (2) the delta mode shipped
+strictly fewer host→device bytes per minor compaction — the byte
+saving the tier exists for.
+
 Usage: python scripts/streaming_smoke.py [--n-events 4000]
+                                         [--mesh-shards 2]
+                                         [--delta-fraction 0.25]
                                          [--out results/serving_smoke.jsonl]
-Exits nonzero on any missing field or parity breach.
+Exits nonzero on any missing field, parity breach, or byte regression.
 """
 
 from __future__ import annotations
@@ -32,24 +43,7 @@ REQUIRED_FIELDS = (
 )
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--n-events", type=int, default=4_000)
-    ap.add_argument("--out", type=str,
-                    default=os.path.join(REPO, "results",
-                                         "serving_smoke.jsonl"))
-    args = ap.parse_args(argv)
-
-    from tuplewise_tpu.serving import ServingConfig
-    from tuplewise_tpu.serving.replay import make_stream, replay
-
-    scores, labels = make_stream(args.n_events, pos_frac=0.5,
-                                 separation=1.0, seed=0)
-    cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
-                        compact_every=256, bg_compact=True)
-    rec = replay(scores, labels, config=cfg, max_inflight=256)
-    rec["stage"] = "ci_smoke"
-
+def _check_common(rec) -> int:
     failures = [f for f in REQUIRED_FIELDS if rec.get(f) is None]
     if failures:
         print(f"SMOKE FAIL: missing/None fields {failures}",
@@ -65,9 +59,126 @@ def main(argv=None) -> int:
         print(f"SMOKE FAIL: auc_abs_err={rec['auc_abs_err']}",
               file=sys.stderr)
         return 1
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
+    return 0
+
+
+def _write(rec, out_path: str) -> None:
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
         f.write(json.dumps(rec) + "\n")
+
+
+def _sharded_delta_leg(args) -> int:
+    """[ISSUE 5 satellite] delta-compaction smoke on a small mesh.
+
+    The per-minor byte margin needs the base to dwarf a delta chunk:
+    below ~6k events the host path's re-placed block is still only a
+    bucket or two, so the leg enforces a floor on the stream length.
+    """
+    import numpy as np
+
+    from tuplewise_tpu.serving import ExactAucIndex, ServingConfig
+    from tuplewise_tpu.serving.replay import make_stream, replay
+
+    n_events = max(args.n_events, 6_000)
+    scores, labels = make_stream(n_events, pos_frac=0.5,
+                                 separation=1.0, seed=0)
+    recs = {}
+    for mode, frac in (("delta", args.delta_fraction),
+                       ("host_merge", 0.0)):
+        cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
+                            compact_every=256, bg_compact=True,
+                            mesh_shards=args.mesh_shards,
+                            delta_fraction=frac,
+                            max_delta_runs=args.max_delta_runs)
+        recs[mode] = replay(scores, labels, config=cfg,
+                            max_inflight=256)
+        recs[mode]["stage"] = f"ci_smoke_sharded_{mode}"
+        rc = _check_common(recs[mode])
+        if rc:
+            return rc
+    delta, host = recs["delta"], recs["host_merge"]
+    # parity bit: the two compaction engines must agree to the BIT on
+    # the exact statistic over the same stream
+    if delta["auc_exact"] != host["auc_exact"]:
+        print(f"SMOKE FAIL: delta vs host-merge AUC mismatch "
+              f"{delta['auc_exact']} != {host['auc_exact']}",
+              file=sys.stderr)
+        return 1
+    # ... and a directly-driven delta index must match the SINGLE-HOST
+    # index's integer win count exactly (windowed, so tombstones +
+    # deltas + a major merge are all exercised)
+    sc32 = scores.astype(np.float32)
+    w = max(256, n_events // 3)
+    sharded = ExactAucIndex(engine="jax", compact_every=128, window=w,
+                            shards=args.mesh_shards,
+                            delta_fraction=args.delta_fraction,
+                            max_delta_runs=args.max_delta_runs)
+    single = ExactAucIndex(engine="jax", compact_every=128, window=w)
+    for i in range(0, len(sc32), 173):
+        j = min(i + 173, len(sc32))
+        sharded.insert_batch(sc32[i:j], labels[i:j])
+        single.insert_batch(sc32[i:j], labels[i:j])
+        if sharded._wins2 != single._wins2:
+            print(f"SMOKE FAIL: wins2 diverged at event {j}",
+                  file=sys.stderr)
+            return 1
+    # the byte saving the tier exists for [ISSUE 5]
+    if not delta["bytes_h2d"]:
+        print("SMOKE FAIL: delta mode recorded zero bytes_h2d",
+              file=sys.stderr)
+        return 1
+    if not (delta["bytes_per_compaction"]
+            and host["bytes_per_compaction"]
+            and delta["bytes_per_compaction"]
+            < host["bytes_per_compaction"]):
+        print(f"SMOKE FAIL: no byte saving per minor compaction "
+              f"(delta {delta['bytes_per_compaction']} vs host "
+              f"{host['bytes_per_compaction']})", file=sys.stderr)
+        return 1
+    _write(delta, args.out)
+    print(
+        f"sharded delta smoke OK (S={args.mesh_shards}): "
+        f"{delta['bytes_per_compaction']:.0f} B/minor vs host "
+        f"{host['bytes_per_compaction']:.0f} B "
+        f"({host['bytes_per_compaction'] / delta['bytes_per_compaction']:.0f}x), "
+        f"major_merges={delta['major_merges']}, "
+        f"auc_abs_err={delta['auc_abs_err']:.1e} -> {args.out}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-events", type=int, default=4_000)
+    ap.add_argument("--mesh-shards", type=int, default=None,
+                    help="run the sharded delta-compaction leg on an "
+                         "N-device mesh instead of the plain smoke")
+    ap.add_argument("--delta-fraction", type=float, default=0.25)
+    ap.add_argument("--max-delta-runs", type=int, default=64)
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "serving_smoke.jsonl"))
+    args = ap.parse_args(argv)
+
+    if args.mesh_shards:
+        return _sharded_delta_leg(args)
+
+    from tuplewise_tpu.serving import ServingConfig
+    from tuplewise_tpu.serving.replay import make_stream, replay
+
+    scores, labels = make_stream(args.n_events, pos_frac=0.5,
+                                 separation=1.0, seed=0)
+    cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
+                        compact_every=256, bg_compact=True)
+    rec = replay(scores, labels, config=cfg, max_inflight=256)
+    rec["stage"] = "ci_smoke"
+
+    rc = _check_common(rec)
+    if rc:
+        return rc
+    _write(rec, args.out)
     print(
         f"streaming smoke OK: {rec['events_per_s']:.0f} ev/s, insert "
         f"p99={rec['insert_latency_p99_ms']:.2f}ms, "

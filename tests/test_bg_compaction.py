@@ -125,6 +125,35 @@ def test_sharded_plus_bg_compact():
     both.close()
 
 
+def test_sharded_delta_plus_bg_compact():
+    """[ISSUE 5] All three layers compose: delta compaction tiers
+    racing the background compactor against a sliding window stay
+    bit-identical to the plain index, and a major merge actually
+    lands."""
+    scores, labels = _stream(2400, seed=23)
+    rng = np.random.default_rng(2)
+    both = ExactAucIndex(engine="jax", compact_every=48, shards=2,
+                         bg_compact=True, window=500,
+                         delta_fraction=0.25, max_delta_runs=3)
+    plain = ExactAucIndex(engine="jax", compact_every=48, window=500)
+    off = 0
+    while off < len(scores):
+        k = min(off + int(rng.integers(1, 64)), len(scores))
+        both.insert_batch(scores[off:k], labels[off:k])
+        plain.insert_batch(scores[off:k], labels[off:k])
+        off = k
+        assert both._wins2 == plain._wins2, off
+        assert both.auc() == plain.auc(), off
+    both.wait_idle()
+    assert both.state()["n_major_merges"] > 0
+    assert both.state()["last_compactor_error"] is None
+    both.compact()
+    assert both._wins2 == plain._wins2
+    assert both.auc() == pytest.approx(
+        _oracle(scores[-500:], labels[-500:]), abs=1e-6)
+    both.close()
+
+
 def test_compact_drains_inflight_builds():
     scores, labels = _stream(600, seed=5)
     idx = ExactAucIndex(engine="numpy", compact_every=32, bg_compact=True)

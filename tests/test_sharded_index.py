@@ -1,4 +1,5 @@
-"""Mesh-sharded serving index [ISSUE 2 tentpole].
+"""Mesh-sharded serving index [ISSUE 2 tentpole; delta compaction
+ISSUE 5].
 
 The contract: sharding the base runs over an S-device mesh (per-shard
 jitted searchsorted + psum'd integer win counts) changes WHERE counts
@@ -6,6 +7,13 @@ are computed, never their values — wins2, every prefix AUC, and every
 fractional rank are bit-identical to the single-host index (and match
 the NumPy midrank oracle) at mesh sizes 1, 2, and 4, on the 8
 virtual-CPU-device test platform.
+
+Delta compaction [ISSUE 5] extends the same contract to the tiered
+engine: minor compactions (delta run placement), tombstone-multiset
+subtraction, on-mesh major merges, and the host fallback must all be
+invisible to the statistic under randomized insert/evict/compact
+schedules — and the major merge must actually run ON the mesh (zero
+host→device bytes) when S >= 2.
 """
 
 import numpy as np
@@ -109,3 +117,193 @@ class TestEngineIntegration:
         assert snap["index"]["shards"] == 2
         assert snap["auc_exact"] == pytest.approx(
             _oracle(scores, labels), abs=1e-6)
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4])
+class TestDeltaCompaction:
+    """[ISSUE 5] The tiered compaction engine — delta runs, tombstone
+    multiset, major merges — is invisible to the statistic."""
+
+    def test_randomized_insert_evict_compact_schedule(self, shards):
+        """Randomized batches against a sliding window (evictions →
+        tombstones), interleaved forced full compactions, and
+        auto-triggered minor/major tiers: wins2 and AUC bit-identical
+        to the single-host index at every step."""
+        rng = np.random.default_rng(shards)
+        scores, labels = _stream(2200, seed=40 + shards)
+        delta = ExactAucIndex(engine="jax", compact_every=48,
+                              shards=shards, window=500,
+                              delta_fraction=0.25, max_delta_runs=3)
+        single = ExactAucIndex(engine="jax", compact_every=48,
+                               window=500)
+        off = 0
+        saw_delta = False
+        while off < len(scores):
+            k = min(off + int(rng.integers(1, 70)), len(scores))
+            delta.insert_batch(scores[off:k], labels[off:k])
+            single.insert_batch(scores[off:k], labels[off:k])
+            off = k
+            assert delta._wins2 == single._wins2, off
+            assert delta.auc() == single.auc(), off
+            saw_delta = saw_delta or delta.state()["delta_events"] > 0
+            if rng.random() < 0.05:
+                delta.compact()     # full consolidation mid-stream
+                assert delta._wins2 == single._wins2, off
+        st = delta.state()
+        assert saw_delta, "schedule never produced a delta run"
+        assert st["n_major_merges"] > 0, "no major merge triggered"
+        assert delta.n_evicted > 0
+        tail_s, tail_l = scores[-500:], labels[-500:]
+        assert delta.auc() == pytest.approx(_oracle(tail_s, tail_l),
+                                            abs=1e-6)
+        q = np.linspace(-3, 3, 29, dtype=np.float32)
+        np.testing.assert_array_equal(delta.score_batch(q),
+                                      single.score_batch(q))
+
+    def test_tombstones_subtract_exactly(self, shards):
+        """Window small vs compact_every: evictions outpace inserts'
+        compactions, so the tombstone multiset (and its overflow full
+        rebuild) carries the parity."""
+        scores, labels = _stream(1500, seed=60 + shards)
+        delta = ExactAucIndex(engine="jax", compact_every=32,
+                              shards=shards, window=300,
+                              delta_fraction=0.5, max_delta_runs=4)
+        single = ExactAucIndex(engine="jax", compact_every=32,
+                               window=300)
+        for i in range(0, 1500, 37):
+            k = min(i + 37, 1500)
+            delta.insert_batch(scores[i:k], labels[i:k])
+            single.insert_batch(scores[i:k], labels[i:k])
+            assert delta._wins2 == single._wins2, k
+        assert delta.auc() == pytest.approx(
+            _oracle(scores[-300:], labels[-300:]), abs=1e-6)
+
+    def test_host_merge_mode_disables_tiers(self, shards):
+        """delta_fraction=0 restores the PR 2 path: no delta runs, no
+        majors, same statistic."""
+        scores, labels = _stream(600, seed=70 + shards)
+        idx = ExactAucIndex(engine="jax", compact_every=64,
+                            shards=shards, delta_fraction=0.0)
+        single = ExactAucIndex(engine="jax", compact_every=64)
+        idx.insert_batch(scores, labels)
+        single.insert_batch(scores, labels)
+        st = idx.state()
+        assert not st["delta_compact"]
+        assert st["n_major_merges"] == 0 and st["delta_events"] == 0
+        assert idx._wins2 == single._wins2
+
+
+class TestOnMeshMajorMerge:
+    """[ISSUE 5] The major merge must actually run on the mesh at
+    S >= 2 — zero host→device bytes — and produce exactly the
+    placement ``place_base`` would."""
+
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_merge_kernel_matches_canonical_placement(self, shards):
+        from tuplewise_tpu.parallel.mesh import make_mesh
+        from tuplewise_tpu.parallel.sharded_counts import (
+            place_base, plan_major_merge, sharded_major_merge,
+        )
+
+        rng = np.random.default_rng(shards)
+        mesh = make_mesh(shards)
+        base = np.sort(rng.standard_normal(4001).astype(np.float32))
+        delta = np.sort(rng.standard_normal(700).astype(np.float32))
+        base_dev, cap, _ = place_base(mesh, base, np.float32)
+        delta_dev, dcap, _ = place_base(mesh, delta, np.float32)
+        plan = plan_major_merge(base, delta, shards)
+        assert plan.ok
+        out, cap_out = sharded_major_merge(
+            mesh, base_dev, cap, ((delta_dev, dcap),), plan)
+        merged = np.sort(np.concatenate([base, delta]))
+        expect_dev, expect_cap, _ = place_base(mesh, merged, np.float32)
+        assert cap_out == expect_cap
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(expect_dev))
+
+    def test_on_mesh_path_taken_and_bytes_saved(self):
+        """At S=2 with spread data the plan fits the one-hop exchange:
+        majors run on-mesh (no fallback) and credit bytes_h2d_saved."""
+        scores, labels = _stream(1600, seed=5)
+        idx = ExactAucIndex(engine="jax", compact_every=64, shards=2,
+                            delta_fraction=0.25, max_delta_runs=3)
+        idx.insert_batch(scores, labels)
+        for i in range(3):   # keep feeding to cross several majors
+            idx.insert_batch(scores[i::3], labels[i::3])
+        snap = idx.metrics.snapshot()
+        assert idx.n_major_merges > 0
+        assert snap["major_merge_fallbacks"]["value"] < idx.n_major_merges
+        assert snap["bytes_h2d_saved"]["value"] > 0
+
+    def test_chaos_major_merge_falls_back_to_host(self):
+        """An injected major_merge fault exercises the host fallback:
+        the statistic is untouched and the fallback is counted."""
+        from tuplewise_tpu.testing.chaos import FaultInjector
+
+        chaos = FaultInjector.from_spec(
+            {"faults": [{"point": "major_merge", "on_call": 1,
+                         "action": "error"}]})
+        scores, labels = _stream(1200, seed=6)
+        idx = ExactAucIndex(engine="jax", compact_every=64, shards=2,
+                            delta_fraction=0.25, max_delta_runs=3,
+                            chaos=chaos)
+        single = ExactAucIndex(engine="jax", compact_every=64)
+        # batched feed: the FIRST major folds into an empty base (host
+        # path, no on-mesh attempt); later majors hit the kernel and
+        # the scheduled fault
+        for i in range(0, 1200, 97):
+            k = min(i + 97, 1200)
+            idx.insert_batch(scores[i:k], labels[i:k])
+            single.insert_batch(scores[i:k], labels[i:k])
+            assert idx._wins2 == single._wins2, k
+        assert idx._wins2 == single._wins2
+        assert idx.metrics.snapshot()["major_merge_fallbacks"][
+            "value"] >= 1
+        assert idx.last_major_merge_error is not None
+        assert chaos.snapshot()["fired"].get("major_merge") == 1
+
+
+class TestPlacementReuse:
+    """[ISSUE 5 satellite] place_base re-ships only changed rows when
+    the bucket geometry is unchanged, and counts the saved bytes."""
+
+    def test_tail_growth_ships_one_row(self):
+        from tuplewise_tpu.parallel.mesh import make_mesh
+        from tuplewise_tpu.parallel.sharded_counts import (
+            place_base, sharded_counts,
+        )
+        from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+        mesh = make_mesh(4)
+        m = MetricsRegistry()
+        rng = np.random.default_rng(0)
+        base = np.sort(rng.standard_normal(999).astype(np.float32))
+        dev, cap, first = place_base(mesh, base, np.float32, metrics=m)
+        assert first == 4 * cap * 4
+        # append one value above the max: per (=250) and cap are
+        # unchanged, rows 0..2 identical — only the tail row ships
+        ext = np.concatenate(
+            [base, np.asarray([base[-1] + 1.0], dtype=np.float32)])
+        dev2, cap2, shipped = place_base(mesh, ext, np.float32,
+                                         prev=(base, dev, cap),
+                                         metrics=m)
+        assert cap2 == cap and shipped == cap * 4
+        assert m.snapshot()["bytes_h2d_saved"]["value"] == 3 * cap * 4
+        q = rng.standard_normal(17).astype(np.float32)
+        less, leq = sharded_counts(mesh, dev2, cap2, q, np.float32)
+        np.testing.assert_array_equal(
+            less, np.searchsorted(ext, q, side="left"))
+        np.testing.assert_array_equal(
+            leq, np.searchsorted(ext, q, side="right"))
+
+    def test_identical_replacement_ships_nothing(self):
+        from tuplewise_tpu.parallel.mesh import make_mesh
+        from tuplewise_tpu.parallel.sharded_counts import place_base
+
+        mesh = make_mesh(2)
+        base = np.sort(np.random.default_rng(1).standard_normal(
+            500).astype(np.float32))
+        dev, cap, _ = place_base(mesh, base, np.float32)
+        dev2, cap2, shipped = place_base(mesh, base, np.float32,
+                                         prev=(base, dev, cap))
+        assert shipped == 0 and dev2 is dev and cap2 == cap

@@ -171,6 +171,12 @@ def _serve_stdin(cfg, chaos=None) -> int:
         "compaction_pause_p99_ms": _p("compaction_pause_s", "p99"),
         "compaction_pause_max_ms": _p("compaction_pause_s", "max"),
         "insert_latency_p99_ms": _p("insert_latency_s", "p99"),
+        # transfer accounting [ISSUE 5]: the shuffle-bytes budget of
+        # the compaction tiers, and the on-mesh merge counters
+        "bytes_h2d": _v("bytes_h2d"),
+        "bytes_h2d_saved": _v("bytes_h2d_saved"),
+        "major_merges_total": _v("major_merges_total"),
+        "major_merge_fallbacks": _v("major_merge_fallbacks"),
         # fault-tolerance counters [ISSUE 3]
         "reshard_events": _v("reshard_events"),
         "bg_compactor_restarts": _v("bg_compactor_restarts"),
@@ -293,6 +299,18 @@ def main(argv=None) -> int:
                        help="compact the exact index on a side thread "
                             "(double-buffered base run; no sort pause "
                             "on the request path)")
+        p.add_argument("--delta-fraction", type=float, default=0.25,
+                       help="sharded index delta compaction [ISSUE 5]: "
+                            "minor compactions ship O(buffer) delta "
+                            "runs and an on-mesh major merge folds "
+                            "them into the base once their mass "
+                            "exceeds this fraction of it; 0 restores "
+                            "the full host-merge + re-placement path")
+        p.add_argument("--max-delta-runs", type=int, default=64,
+                       help="fold the delta run into the base after "
+                            "this many minor compactions merged into "
+                            "it, regardless of its size (safety bound;"
+                            " --delta-fraction normally rules)")
         p.add_argument("--max-batch", type=int, default=256)
         p.add_argument("--flush-timeout-ms", type=float, default=2.0)
         p.add_argument("--queue-size", type=int, default=1024)
@@ -357,7 +375,10 @@ def main(argv=None) -> int:
             reservoir=args.reservoir, design=args.design,
             window=args.window, compact_every=args.compact_every,
             engine=args.engine, mesh_shards=args.mesh_shards,
-            bg_compact=args.bg_compact, max_batch=args.max_batch,
+            bg_compact=args.bg_compact,
+            delta_fraction=args.delta_fraction,
+            max_delta_runs=args.max_delta_runs,
+            max_batch=args.max_batch,
             flush_timeout_s=args.flush_timeout_ms / 1e3,
             queue_size=args.queue_size, policy=args.policy,
             deadline_s=(args.deadline_ms / 1e3

@@ -21,12 +21,43 @@ via the mesh backend's row placement. The jitted count kernel is cached
 per (mesh, cap, q_bucket), giving O(log n) distinct compiled shapes as
 the base run grows through the bucket ladder — the same discipline as
 the single-host index.
+
+**Delta runs** [ISSUE 5]: additivity extends to any NUMBER of sorted
+runs, so the index's delta-compaction mode places a small sorted delta
+run next to the base and counts ``base + delta`` in ONE jitted call
+under ONE psum (``sharded_multi_count_fn``) — shipping O(buffer)
+bytes per minor compaction instead of re-placing the O(n) base. The
+index keeps the delta CONSOLIDATED (one run), so compiled shapes
+follow the two bucket ladders, never a transient run count.
+
+**On-mesh major merge** [ISSUE 5]: folding the deltas back into the
+base never round-trips through the host. The host (authoritative for
+the runs) computes a merge *plan* — for each output shard, the
+contiguous base-rank and delta-rank windows whose union is exactly its
+slice of the merged run (any contiguous rank range of a two-way merge
+is the merge of contiguous ranges of the inputs) — and the jitted
+kernel executes it: each shard ``all_gather``s the (small) delta
+blocks, receives its base-boundary overlap from its mesh NEIGHBORS via
+two ``lax.ppermute`` block exchanges, selects its windows, and sorts
+them into its output row. Interconnect traffic is O(Σ|deltas| +
+per-shard block) per link; host→device traffic is ZERO. The plan is
+valid when every output shard's base window lies within one hop of its
+own slice (always true once the base dominates the deltas — the
+steady state the trigger guarantees); otherwise the caller falls back
+to the host merge + full re-placement.
+
+``place_base`` also accounts every host→device byte it ships
+(``bytes_h2d``) and — when the bucket ladder's (per, cap) geometry is
+unchanged — re-ships only the rows whose content actually changed,
+reassembling the block from the surviving per-device shards
+(``bytes_h2d_saved`` counts what the naive full re-ship would have
+cost) [ISSUE 5 satellite].
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import NamedTuple, Sequence, Tuple
 
 import numpy as np
 
@@ -44,27 +75,120 @@ def mesh_size(mesh) -> int:
     return int(np.prod(mesh.devices.shape))
 
 
-def place_base(mesh, sorted_arr: np.ndarray, dtype) -> Tuple[object, int]:
-    """Pad + place a sorted base run as [S, cap] contiguous slices.
+def _block(sorted_arr: np.ndarray, S: int, per: int, cap: int,
+           dtype) -> np.ndarray:
+    """The [S, cap] host block ``place_base`` ships: one sorted slice
+    per row, +inf padded."""
+    out = np.full((S, cap), np.inf, dtype=dtype)
+    for s in range(S):
+        chunk = sorted_arr[s * per:(s + 1) * per]
+        out[s, : len(chunk)] = chunk
+    return out
 
-    Returns (device_array, cap). Each row holds one sorted slice padded
-    with +inf; rows are placed one-per-device via the mesh backend's
-    row placement (the same NamedSharding the ring estimators use).
+
+def _count_bytes(metrics, shipped: int, saved: int) -> None:
+    if metrics is None:
+        return
+    if shipped:
+        metrics.counter("bytes_h2d").inc(shipped)
+    if saved:
+        metrics.counter("bytes_h2d_saved").inc(saved)
+
+
+def place_base(mesh, sorted_arr: np.ndarray, dtype, *, prev=None,
+               metrics=None, chaos=None) -> Tuple[object, int, int]:
+    """Pad + place a sorted run as [S, cap] contiguous slices.
+
+    Returns ``(device_array, cap, shipped_bytes)``. Each row holds one
+    sorted slice padded with +inf; rows are placed one-per-device via
+    the mesh backend's row placement (the same NamedSharding the ring
+    estimators use).
+
+    ``prev`` — ``(prev_arr, prev_dev, prev_cap)`` of the placement this
+    one replaces. When the bucket geometry (per, cap) is unchanged,
+    rows whose content is identical are NOT re-shipped: the new block
+    is assembled from the surviving single-device shards plus
+    device_puts of only the changed rows [ISSUE 5 satellite]. The
+    saved bytes are credited to ``bytes_h2d_saved``.
+
+    ``metrics`` — a MetricsRegistry receiving ``bytes_h2d`` /
+    ``bytes_h2d_saved``; ``chaos`` fires the ``place_base`` hook.
     """
     import jax
     import jax.numpy as jnp
 
     from tuplewise_tpu.backends.mesh_backend import row_sharding
 
+    if chaos is not None:
+        chaos.fire("place_base")
     S = mesh_size(mesh)
     n = len(sorted_arr)
     per = -(-n // S) if n else 0       # ceil; 0 rows only when base empty
     cap = next_bucket(max(per, 1))
-    out = np.full((S, cap), np.inf, dtype=dtype)
+    itemsize = np.dtype(dtype).itemsize
+    full_bytes = S * cap * itemsize
+
+    changed = None
+    if prev is not None:
+        prev_arr, prev_dev, prev_cap = prev
+        if (prev_arr is not None and prev_dev is not None
+                and prev_cap == cap
+                and (-(-len(prev_arr) // S) if len(prev_arr) else 0) == per):
+            changed = []
+            for s in range(S):
+                a = sorted_arr[s * per:(s + 1) * per]
+                b = prev_arr[s * per:(s + 1) * per]
+                if len(a) != len(b) or not np.array_equal(a, b):
+                    changed.append(s)
+            if not changed:
+                _count_bytes(metrics, 0, full_bytes)
+                return prev_dev, cap, 0
+            if len(changed) < S:
+                try:
+                    dev = _reuse_rows(mesh, prev_dev, sorted_arr, changed,
+                                      S, per, cap, dtype)
+                    shipped = len(changed) * cap * itemsize
+                    _count_bytes(metrics, shipped, full_bytes - shipped)
+                    return dev, cap, shipped
+                except Exception:
+                    pass    # any API/topology mismatch: full re-ship
+
+    out = _block(sorted_arr, S, per, cap, dtype)
+    dev = jax.device_put(jnp.asarray(out), row_sharding(mesh))
+    _count_bytes(metrics, full_bytes, 0)
+    return dev, cap, full_bytes
+
+
+def _reuse_rows(mesh, prev_dev, sorted_arr: np.ndarray,
+                changed: Sequence[int], S: int, per: int, cap: int,
+                dtype):
+    """Assemble a [S, cap] placement shipping only ``changed`` rows:
+    unchanged rows reuse the previous placement's single-device shards
+    in place (zero transfer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tuplewise_tpu.backends.mesh_backend import row_sharding
+
+    sharding = row_sharding(mesh)
+    by_row = {}
+    for sh in prev_dev.addressable_shards:
+        by_row[sh.index[0].start or 0] = sh
+    if sorted(by_row) != list(range(S)):
+        raise RuntimeError("previous placement does not cover the mesh")
+    changed_set = set(changed)
+    pieces = []
     for s in range(S):
-        chunk = sorted_arr[s * per:(s + 1) * per]
-        out[s, : len(chunk)] = chunk
-    return jax.device_put(jnp.asarray(out), row_sharding(mesh)), cap
+        if s in changed_set:
+            row = np.full((1, cap), np.inf, dtype=dtype)
+            chunk = sorted_arr[s * per:(s + 1) * per]
+            row[0, : len(chunk)] = chunk
+            pieces.append(jax.device_put(jnp.asarray(row),
+                                         by_row[s].device))
+        else:
+            pieces.append(by_row[s].data)
+    return jax.make_array_from_single_device_arrays(
+        (S, cap), sharding, pieces)
 
 
 @functools.lru_cache(maxsize=None)
@@ -99,9 +223,52 @@ def sharded_count_fn(mesh, cap: int, q_bucket: int):
     return f
 
 
+@functools.lru_cache(maxsize=None)
+def sharded_multi_count_fn(mesh, caps: Tuple[int, ...], q_bucket: int):
+    """Jitted multi-run counts: (runs tuple of [S, cap_i], queries) ->
+    (less, leq) summed over EVERY run under ONE psum [ISSUE 5].
+
+    Counting is additive over runs, so base + delta-run counts need one
+    collective, not one per run; the compile cache is keyed on the cap
+    tuple — bounded by the bucket ladder times ``max_delta_runs``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    k = len(caps)
+
+    def body(runs, q):
+        less = jnp.zeros(q.shape, dtype=jnp.int32)
+        leq = jnp.zeros(q.shape, dtype=jnp.int32)
+        for b in runs:
+            less = less + jnp.searchsorted(b[0], q, side="left")
+            leq = leq + jnp.searchsorted(b[0], q, side="right")
+        return lax.psum(less, axes), lax.psum(leq, axes)
+
+    @jax.jit
+    def f(runs, q):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=((P(axes),) * k, P()), out_specs=(P(), P()),
+            check_vma=False,
+        )(runs, q)
+
+    return f
+
+
 def sharded_counts(mesh, base_dev, cap: int, q: np.ndarray,
-                   dtype, chaos=None) -> Tuple[np.ndarray, np.ndarray]:
-    """(less, leq) int64 counts of queries against the placed base run.
+                   dtype, chaos=None,
+                   deltas: Sequence[Tuple[object, int]] = ()
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """(less, leq) int64 counts of queries against the placed run(s).
+
+    ``deltas`` — additional placed sorted runs ``(device_array, cap)``
+    (the index's delta runs); their counts are summed with the base's
+    inside one jitted call / one psum. ``base_dev`` may be None when
+    only deltas exist (fresh index whose base never formed).
 
     ``chaos`` (a ``testing.chaos.FaultInjector``) fires the
     ``sharded_count`` hook before the device call — a scheduled fault
@@ -114,6 +281,313 @@ def sharded_counts(mesh, base_dev, cap: int, q: np.ndarray,
     qb = next_bucket(len(q))
     q_p = np.zeros(qb, dtype=dtype)
     q_p[: len(q)] = q
-    less, leq = sharded_count_fn(mesh, cap, qb)(base_dev, q_p)
+    runs, caps = [], []
+    if base_dev is not None:
+        runs.append(base_dev)
+        caps.append(cap)
+    for d, c in deltas:
+        runs.append(d)
+        caps.append(c)
+    if not runs:
+        z = np.zeros(len(q), dtype=np.int64)
+        return z, z
+    if len(runs) == 1:
+        less, leq = sharded_count_fn(mesh, caps[0], qb)(runs[0], q_p)
+    else:
+        less, leq = sharded_multi_count_fn(
+            mesh, tuple(caps), qb)(tuple(runs), q_p)
     return (np.asarray(less)[: len(q)].astype(np.int64),
             np.asarray(leq)[: len(q)].astype(np.int64))
+
+
+# --------------------------------------------------------------------- #
+# on-mesh major merge [ISSUE 5]                                         #
+# --------------------------------------------------------------------- #
+
+class MergePlan(NamedTuple):
+    """Host-computed plan for the on-mesh merge.
+
+    ``pos`` — each delta element's rank in the merged run (padded to a
+    bucket with an out-of-range sentinel); ``meta = (n, per_b,
+    per_out, n_out)``; ``cap_out`` is the output bucket; ``ok`` is
+    False when some output shard's base window reaches beyond the
+    one-hop neighbor blocks (the caller then takes the host fallback).
+    """
+
+    pos: np.ndarray
+    meta: np.ndarray
+    cap_out: int
+    per_out: int
+    ok: bool
+
+
+def plan_major_merge(base: np.ndarray, delta_full: np.ndarray,
+                     S: int) -> MergePlan:
+    """Compute the merge plan on the host.
+
+    The host is authoritative for both sorted runs, so the plan is one
+    ``searchsorted``: delta element j lands at merged rank
+    ``searchsorted(base, d_j, 'right') + j`` (base-before-delta on
+    ties). The one-hop validity check counts delta ranks below each
+    output shard boundary. O(m log n) host work for an O(n) merge —
+    the expensive part stays on the mesh; only O(m) plan integers ride
+    along (the same order as the delta itself).
+    """
+    n, m = len(base), len(delta_full)
+    per_b = -(-n // S)
+    n_out = n + m
+    per_out = -(-n_out // S)
+    cap_out = next_bucket(max(per_out, 1))
+    pos = np.searchsorted(base, delta_full, side="right") + np.arange(m)
+    lo = per_out * np.arange(S, dtype=np.int64)
+    hi = np.minimum(n_out, lo + per_out)
+    lo_d = np.searchsorted(pos, lo, side="left")
+    hi_d = np.searchsorted(pos, hi, side="left")
+    lo_b = lo - lo_d
+    hi_b = hi - hi_d
+    s_idx = np.arange(S, dtype=np.int64)
+    ok = bool(np.all(lo_b >= (s_idx - 1) * per_b)
+              and np.all(hi_b <= (s_idx + 2) * per_b))
+    pos_pad = np.full(next_bucket(max(m, 1)), np.iinfo(np.int32).max,
+                      dtype=np.int32)
+    pos_pad[:m] = pos
+    meta = np.asarray([n, per_b, per_out, n_out], dtype=np.int32)
+    return MergePlan(pos=pos_pad, meta=meta, cap_out=cap_out,
+                     per_out=per_out, ok=ok)
+
+
+@functools.lru_cache(maxsize=None)
+def delta_append_fn(mesh, cap_old: int, cap_chunk: int, cap_new: int):
+    """Jitted per-shard append of a placed chunk into the placed delta
+    run [ISSUE 5]: each shard rank-merges its (sorted) delta row with
+    its (sorted) chunk row — no collectives, no host traffic beyond
+    the O(b) chunk itself. Rows need not partition the delta
+    contiguously: counting is additive over ANY partition into sorted
+    runs, so per-row sorted unions are exactly as good as slices.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+
+    def body(old, chunk):
+        o = old[0]
+        c_row = chunk[0]
+        if cap_new > cap_old:
+            o = jnp.concatenate(
+                [o, jnp.full(cap_new - cap_old, jnp.inf, o.dtype)])
+        jc = jnp.arange(cap_chunk, dtype=jnp.int32)
+        # chunk padding (+inf) is banished out of range -> dropped
+        pd = jnp.where(jnp.isfinite(c_row),
+                       jc + jnp.searchsorted(o, c_row, side="right"),
+                       cap_new)
+        marks = jnp.zeros(cap_new, dtype=jnp.int32
+                          ).at[pd].add(1, mode="drop")
+        i = jnp.arange(cap_new, dtype=jnp.int32)
+        cum = jnp.cumsum(marks) - marks
+        take_c = c_row[jnp.clip(cum, 0, cap_chunk - 1)]
+        take_o = o[jnp.clip(i - cum, 0, cap_new - 1)]
+        return jnp.where(marks > 0, take_c, take_o)[None]
+
+    @jax.jit
+    def f(old, chunk):
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(axes), P(axes)),
+                             out_specs=P(axes), check_vma=False,
+                             )(old, chunk)
+
+    return f
+
+
+# The merge executes as a SEQUENCE of short device programs — one
+# boundary-exchange window build, then cap_out/_MERGE_CHUNK chunk
+# programs, then one assembly concat — rather than one monolithic
+# kernel: the merge shares the device with the request path's count
+# kernels, so the LONGEST single program (not the merge total) is the
+# pause ceiling a compaction can impose on a concurrent count. Chunking
+# bounds that quantum; counts interleave between chunks.
+_MERGE_CHUNK = 32768
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_window_fn(mesh, cap_base: int):
+    """Jitted neighbor boundary exchange: each shard receives BOTH
+    neighbors' base blocks via ``lax.ppermute`` (an output slice's
+    base window can overhang into the adjacent shards' slices after
+    rebalancing) and returns its [3, cap_base] window, flattened to
+    keep the output row-sharded."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    axis = axes[0]
+    S = mesh_size(mesh)
+    fwd = [(i, (i + 1) % S) for i in range(S)]     # receive left block
+    bwd = [(i, (i - 1) % S) for i in range(S)]     # receive right block
+
+    def body(base):
+        from_left = lax.ppermute(base[0], axis, fwd)
+        from_right = lax.ppermute(base[0], axis, bwd)
+        return jnp.concatenate([from_left, base[0], from_right])[None]
+
+    @jax.jit
+    def f(base_sh):
+        return jax.shard_map(body, mesh=mesh, in_specs=P(axes),
+                             out_specs=P(axes), check_vma=False,
+                             )(base_sh)
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_delta_fn(mesh, delta_caps: Tuple[int, ...]):
+    """Jitted delta replication: ``all_gather`` the placed delta
+    blocks and sort once (+inf padding sorts to the tail, so ranks
+    [0, m) are the delta multiset) — shared by every merge chunk."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    axis = axes[0]
+
+    def body(deltas):
+        return jnp.sort(jnp.concatenate(
+            [lax.all_gather(d[0], axis, tiled=True) for d in deltas]))
+
+    @jax.jit
+    def f(delta_shs):
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=((P(axes),) * len(delta_caps),),
+                             out_specs=P(), check_vma=False,
+                             )(delta_shs)
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_chunk_fn(mesh, cap_base: int, delta_cap: int,
+                    pos_cap: int, chunk: int):
+    """Jitted merge chunk: build ``chunk`` consecutive slots of every
+    shard's output row by rank arithmetic — no sort, no out-sized
+    search.
+
+    Output slot r (global rank ``s*per_out + chunk_start + i``) holds
+    a delta element iff r is one of the host-planned delta positions
+    (one small binary search over ``pos``); otherwise it holds base
+    rank ``r - #deltas_before``, gathered from the one-hop window.
+    The delta VALUES come from :func:`_merge_delta_fn`'s replicated
+    gather of the placed blocks — zero host→device data bytes.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    axis = axes[0]
+
+    def body(window, delta_full, pos, meta, chunk_start):
+        s = lax.axis_index(axis)
+        n, per_b, per_out = meta[0], meta[1], meta[2]
+        n_out = meta[3]
+        w = window[0].reshape(3, cap_base)
+        local = chunk_start + jnp.arange(chunk, dtype=jnp.int32)
+        start = s * per_out + chunk_start
+        r = s * per_out + local                 # global output ranks
+        # deltas-before-each-slot WITHOUT an out-sized binary search:
+        # the planned positions hitting this window are a CONTIGUOUS
+        # range of the sorted ``pos`` (at most ``chunk`` of them), so
+        # dynamic-slice that range, scatter it into per-slot marks,
+        # cumsum, and offset by the scalar count below the window —
+        # O(chunk) work, one scalar search
+        c_lo = jnp.searchsorted(pos, start, side="left")
+        pos_win = lax.dynamic_slice(pos, (c_lo,), (chunk,))
+        rel = pos_win - start
+        # negative indices would WRAP (NumPy semantics) before the
+        # drop check — clamp them out of range instead
+        rel = jnp.where(rel >= 0, rel, chunk)
+        marks = jnp.zeros(chunk, dtype=jnp.int32
+                          ).at[rel].add(1, mode="drop")
+        c = c_lo + jnp.cumsum(marks) - marks
+        is_d = marks > 0
+        b_rank = r - c
+        blk = b_rank // per_b - (s - 1)
+        off = b_rank - (b_rank // per_b) * per_b
+        bval = w[jnp.clip(blk, 0, 2), jnp.clip(off, 0, cap_base - 1)]
+        bval = jnp.where((b_rank < n) & (blk >= 0) & (blk < 3),
+                         bval, jnp.inf)
+        dval = delta_full[jnp.clip(c, 0, delta_full.shape[0] - 1)]
+        out = jnp.where(is_d, dval, bval)
+        valid = (local < per_out) & (r < n_out)
+        return jnp.where(valid, out, jnp.inf)[None]
+
+    @jax.jit
+    def f(window, delta_full, pos, meta, chunk_start):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axes), P(), P(), P(), P()),
+            out_specs=P(axes), check_vma=False,
+        )(window, delta_full, pos, meta, chunk_start)
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_assemble_fn(mesh, chunk: int, parts: int):
+    """Jitted concat of the chunk outputs into the [S, cap_out] row."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+
+    def body(*chunks):
+        return jnp.concatenate([c[0] for c in chunks])[None]
+
+    @jax.jit
+    def f(*chunks):
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(axes),) * parts,
+                             out_specs=P(axes), check_vma=False,
+                             )(*chunks)
+
+    return f
+
+
+def sharded_major_merge(mesh, base_dev, cap_base: int,
+                        delta_devs: Sequence[Tuple[object, int]],
+                        plan: MergePlan, chaos=None
+                        ) -> Tuple[object, int]:
+    """Execute a host-computed :func:`plan_major_merge` on the mesh;
+    returns the merged ``(device_array, cap_out)`` — exactly the
+    layout ``place_base`` would produce. No base bytes cross the
+    host→device boundary; only the O(m) plan integers ride along.
+    ``chaos`` fires the ``major_merge`` hook (a raise here exercises
+    the index's host fallback) [ISSUE 5].
+    """
+    if chaos is not None:
+        chaos.fire("major_merge")
+    caps = tuple(c for _, c in delta_devs)
+    deltas = tuple(d for d, _ in delta_devs)
+    chunk = min(plan.cap_out, _MERGE_CHUNK)
+    parts = plan.cap_out // chunk
+    pos = plan.pos
+    if len(pos) < chunk:    # dynamic_slice window needs >= chunk
+        pad = np.full(chunk - len(pos), np.iinfo(np.int32).max,
+                      dtype=np.int32)
+        pos = np.concatenate([pos, pad])
+    window = _merge_window_fn(mesh, cap_base)(base_dev)
+    delta_full = _merge_delta_fn(mesh, caps)(deltas)
+    fchunk = _merge_chunk_fn(mesh, cap_base, int(delta_full.shape[0]),
+                             len(pos), chunk)
+    outs = [fchunk(window, delta_full, pos, plan.meta,
+                   np.int32(k * chunk)) for k in range(parts)]
+    if parts == 1:
+        return outs[0], plan.cap_out
+    return (_merge_assemble_fn(mesh, chunk, parts)(*outs),
+            plan.cap_out)

@@ -91,6 +91,11 @@ class ServingConfig:
     engine: str = "jax"            # index count/compaction engine
     mesh_shards: Optional[int] = None  # shard index base runs over a mesh
     bg_compact: bool = False       # compact on a side thread (no sort pause)
+    # delta compaction [ISSUE 5] (sharded index only): > 0 ships O(b)
+    # delta runs per minor compaction and folds them back on-mesh once
+    # they exceed this fraction of the base; 0 = PR 2 host-merge path
+    delta_fraction: float = 0.25
+    max_delta_runs: int = 64       # fold after this many minors merged
     max_batch: int = 256           # micro-batch size cap
     flush_timeout_s: float = 0.002  # batcher drain window
     queue_size: int = 1024         # bounded request queue
@@ -119,6 +124,12 @@ class ServingConfig:
         if self.snapshot_every < 1:
             raise ValueError(
                 f"snapshot_every must be >= 1: {self.snapshot_every}")
+        if self.delta_fraction < 0:
+            raise ValueError(
+                f"delta_fraction must be >= 0: {self.delta_fraction}")
+        if self.max_delta_runs < 1:
+            raise ValueError(
+                f"max_delta_runs must be >= 1: {self.max_delta_runs}")
         if self.recover and not self.snapshot_dir:
             raise ValueError("recover=True needs snapshot_dir")
         if self.wal_fsync not in ("snapshot", "batch"):
@@ -160,7 +171,8 @@ class MicroBatchEngine:
             window=config.window, compact_every=config.compact_every,
             engine=config.engine, shards=config.mesh_shards,
             bg_compact=config.bg_compact, metrics=self.metrics,
-            chaos=chaos,
+            chaos=chaos, delta_fraction=config.delta_fraction,
+            max_delta_runs=config.max_delta_runs,
         ) if config.kernel == "auc" else None
         self.streaming = StreamingIncompleteU(
             kernel=config.kernel, budget=config.budget,

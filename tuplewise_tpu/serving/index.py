@@ -70,6 +70,44 @@ synchronous compaction for that trigger. Chaos schedules
 (``testing.chaos.FaultInjector``) drive both paths deterministically
 in tests and CI.
 
+**Delta compaction** [ISSUE 5]: under a mesh, steady-state compaction
+cost is proportional to the DELTA, not the base. The paper's whole
+trade-off (Vogel et al.) prices communication against statistical
+cost, and the PR 2 compaction paid the one cost the analysis warns
+against: a full O(n) host splice-merge plus an O(n) host→device
+re-placement per O(b) merge buffer. With ``delta_fraction > 0`` (the
+default) a sharded index compacts in three tiers:
+
+* **minor** — splice the pending buffer into a small sorted *delta
+  run* and place only that run's O(|delta|) bytes on-mesh (bounded by
+  ``max_delta_runs`` buffers — never the base); the count kernel sums
+  ``base + delta`` under one psum. Exactness is free: counting is
+  additive over any partition of the multiset into sorted runs. The
+  delta stays ONE consolidated run so every compiled shape follows
+  the two bucket ladders, never the compactor's transient backlog.
+  Evictions of values living in the base or the delta run become
+  tombstones consolidated into a sorted *tombstone multiset*
+  (``tomb_run``) whose counts are SUBTRACTED — additivity over signed
+  multisets, so every prefix stays bit-identical.
+* **major** — when ``|delta| > delta_fraction·|base|`` (or
+  ``max_delta_runs`` minors have been folded into the run), merge the
+  delta into the base ON-MESH: the host plans per-shard merge
+  windows, the jitted kernel all_gathers the (small) delta, exchanges
+  base boundary blocks with mesh neighbors (``lax.ppermute``), and
+  sorts each output row in place — ZERO host→device bytes (the host
+  updates its authoritative copy with the single-allocation splice
+  merge). S=1 meshes and plans that would need more than a one-hop
+  exchange fall back to the host merge + full re-placement.
+* **full** — explicit ``compact()`` or a tombstone multiset outgrowing
+  ``delta_fraction·|base|``: everything folds into one run on the
+  host and is re-placed (the PR 2 path, kept as the fallback engine).
+
+Every placement is byte-accounted: ``bytes_h2d`` / ``bytes_h2d_saved``
+counters and a per-minor ``compaction_bytes`` histogram, plus
+``major_merge_s`` / ``major_merges_total`` — the serving-side shuffle
+budget, reported by the serve exit summary, ``replay`` records, and
+``bench.py --streaming``.
+
 Scores must be finite (the +inf bucket padding relies on it).
 """
 
@@ -92,6 +130,29 @@ def _next_bucket(n: int) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _splice_merge(base: np.ndarray, new_sorted: np.ndarray) -> np.ndarray:
+    """Merge two sorted arrays into ONE pre-sized output buffer.
+
+    The old path (``np.insert(base, np.searchsorted(...), buf)``) built
+    an index array and let ``np.insert`` copy the base again through
+    its generic slow path; this allocates the output once and writes
+    each input exactly once — O(n + b) with a single O(n+b) allocation
+    [ISSUE 5 satellite].
+    """
+    if len(new_sorted) == 0:
+        return base
+    if len(base) == 0:
+        return np.asarray(new_sorted, dtype=base.dtype)
+    out = np.empty(len(base) + len(new_sorted), dtype=base.dtype)
+    pos = (np.searchsorted(base, new_sorted, side="right")
+           + np.arange(len(new_sorted)))
+    mask = np.ones(len(out), dtype=bool)
+    mask[pos] = False
+    out[pos] = new_sorted
+    out[mask] = base
+    return out
 
 
 def _remove_sorted(arr: np.ndarray, values: List[float]) -> np.ndarray:
@@ -136,11 +197,24 @@ def _jit_sort_fn(bucket: int):
 
 
 class _ClassSide:
-    """One class's LSM container: sorted base + buffer + tombstones.
+    """One class's LSM container: sorted base + delta runs + buffer +
+    tombstones.
 
     ``snap_buf``/``snap_tomb`` mark the prefix lengths an in-flight
     background build has snapshotted (0 when idle): mutators must treat
     those prefixes as immutable, and the swap trims exactly them.
+
+    Delta mode [ISSUE 5] adds: ``delta_run`` — the consolidated sorted
+    run of every insert not yet folded into the base (mirrored on-mesh
+    by the placed ``delta_dev``/``delta_cap``; ``delta_minors`` counts
+    the minor compactions merged into it since the last fold);
+    ``tomb_run`` — the sorted tombstone multiset (evicted values
+    physically inside base/delta) whose counts are subtracted;
+    ``placed_base`` — the host array the current device placement
+    mirrors (the row-reuse baseline). While a background job runs
+    (``building``), the worker owns base, delta_run, tomb_run and
+    placed_base exclusively — mutators only append to buf/tomb and
+    remove from the unsnapshotted buf suffix.
     """
 
     def __init__(self, dtype):
@@ -148,15 +222,23 @@ class _ClassSide:
         self.base = np.empty(0, dtype=dtype)
         self.buf: List[float] = []
         self.tomb: List[float] = []
+        self.delta_run = np.empty(0, dtype=dtype)
+        self.delta_dev = None
+        self.delta_cap = 0
+        self.delta_rows = None   # per-shard row occupancy of delta_dev
+        self.delta_minors = 0
+        self.tomb_run = np.empty(0, dtype=dtype)
         self.base_dev = None     # [S, cap] device shards (sharded mode)
         self.cap = 0
+        self.placed_base = None  # host array base_dev mirrors
         self.building = False
         self.snap_buf = 0
         self.snap_tomb = 0
 
     @property
     def size(self) -> int:
-        return len(self.base) + len(self.buf) - len(self.tomb)
+        return (len(self.base) + len(self.delta_run)
+                + len(self.buf) - len(self.tomb) - len(self.tomb_run))
 
     @property
     def pending(self) -> Tuple[int, int]:
@@ -167,10 +249,11 @@ class _ClassSide:
     def values(self) -> np.ndarray:
         """Current multiset as an array (oracle/debug path, O(n))."""
         out = np.concatenate(
-            [self.base, np.asarray(self.buf, dtype=self.dtype)]
+            [self.base, self.delta_run,
+             np.asarray(self.buf, dtype=self.dtype)]
         )
         out = np.sort(out, kind="stable")
-        return _remove_sorted(out, self.tomb)
+        return _remove_sorted(out, self.tomb_run.tolist() + self.tomb)
 
 
 class ExactAucIndex:
@@ -192,6 +275,17 @@ class ExactAucIndex:
       bg_compact: move compaction merges to a side thread with a
         double-buffered base run and an atomic swap; the insert path
         never blocks on a sort.
+      delta_fraction: [ISSUE 5] sharded mode only. > 0 (default 0.25)
+        enables delta compaction: minor compactions ship an O(buffer)
+        delta run instead of re-placing the O(n) base, and a major
+        merge folds the delta back in ON-MESH once ``|delta|`` exceeds
+        this fraction of the base. 0 restores the PR 2 host-merge +
+        full-re-placement path (the comparison baseline in
+        ``bench.py --streaming``).
+      max_delta_runs: fold the delta run into the base after this many
+        minor compactions have been merged into it, regardless of its
+        size — bounds the delta run's growth and therefore each
+        minor's splice-and-ship cost.
       metrics: a ``utils.profiling.MetricsRegistry`` to record
         ``compactions_total`` / ``compaction_pause_s`` into (the engine
         passes its own so pauses surface in ``stats()``); None = a
@@ -216,7 +310,9 @@ class ExactAucIndex:
                  shards: Optional[int] = None, mesh=None,
                  bg_compact: bool = False, metrics=None, chaos=None,
                  shard_retries: int = 3, retry_backoff_s: float = 0.02,
-                 probe_timeout_s: float = 5.0):
+                 probe_timeout_s: float = 5.0,
+                 delta_fraction: float = 0.25,
+                 max_delta_runs: int = 64):
         if engine not in ("jax", "numpy"):
             raise ValueError(f"engine must be 'jax' or 'numpy': {engine!r}")
         if window is not None and window < 2:
@@ -229,11 +325,22 @@ class ExactAucIndex:
             raise ValueError(f"shards must be >= 1, got {shards}")
         if shards is not None and engine != "jax":
             raise ValueError("sharded base runs need engine='jax'")
+        if delta_fraction < 0:
+            raise ValueError(
+                f"delta_fraction must be >= 0: {delta_fraction}")
+        if max_delta_runs < 1:
+            raise ValueError(
+                f"max_delta_runs must be >= 1: {max_delta_runs}")
         self.window = window
         self.compact_every = compact_every
         self.engine = engine
         self.shards = shards
         self.bg_compact = bg_compact
+        self.delta_fraction = float(delta_fraction)
+        self.max_delta_runs = int(max_delta_runs)
+        # delta compaction needs the mesh (the whole point is cutting
+        # host->device bytes); single-host mode keeps the plain path
+        self._delta = shards is not None and self.delta_fraction > 0
         self.chaos = chaos
         self.shard_retries = shard_retries
         self.retry_backoff_s = retry_backoff_s
@@ -250,12 +357,26 @@ class ExactAucIndex:
         self._log: Deque[Tuple[float, bool]] = collections.deque()
         self._wins2 = 0          # exact: Python int never overflows
         self.n_compactions = 0
+        self.n_major_merges = 0
         self.n_evicted = 0
-        from tuplewise_tpu.utils.profiling import MetricsRegistry
+        from tuplewise_tpu.utils.profiling import (
+            BYTE_BUCKETS, MetricsRegistry,
+        )
 
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._c_compactions = self.metrics.counter("compactions_total")
         self._h_pause = self.metrics.histogram("compaction_pause_s")
+        # transfer accounting [ISSUE 5]: host->device bytes are the
+        # serving-side shuffle budget; place_base feeds the counters,
+        # minor compactions feed the per-event histogram
+        self._c_bytes = self.metrics.counter("bytes_h2d")
+        self._c_bytes_saved = self.metrics.counter("bytes_h2d_saved")
+        self._h_compaction_bytes = self.metrics.histogram(
+            "compaction_bytes", buckets=BYTE_BUCKETS)
+        self._h_major = self.metrics.histogram("major_merge_s")
+        self._c_major = self.metrics.counter("major_merges_total")
+        self._c_major_fb = self.metrics.counter("major_merge_fallbacks")
+        self.last_major_merge_error = None
         # fault-tolerance observability [ISSUE 3]: the reshard/retry/
         # recovery counters are registered here (create-or-return) so
         # snapshots carry them even before any healer exists, and the
@@ -268,6 +389,12 @@ class ExactAucIndex:
         # [ISSUE 4] — one implementation for serving AND the batch
         # path; shrink policy (fixed_width=None): counts are additive
         # over any partition, so a narrower mesh stays bit-identical
+        # query bucket sizes seen so far — the compactor pre-warms the
+        # count kernel for (new placement geometry x these buckets)
+        # BEFORE each swap, so a compile never lands on the request
+        # thread [ISSUE 5]
+        self._q_buckets = set()
+        self._warmed = set()    # placement geometries already warmed
         self._healer = None
         if shards is not None:
             from tuplewise_tpu.parallel.self_heal import Backoff, MeshHealer
@@ -296,12 +423,19 @@ class ExactAucIndex:
     # ------------------------------------------------------------------ #
     def _base_counts(self, side: _ClassSide,
                      q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """(less, leq) counts of each query against side.base."""
-        if len(side.base) == 0 or len(q) == 0:
-            z = np.zeros(len(q), dtype=np.int64)
+        """(less, leq) counts of each query against side.base plus —
+        in delta mode — every placed delta run (one call, one psum)."""
+        if len(q) == 0:
+            z = np.zeros(0, dtype=np.int64)
             return z, z
         if self.shards is not None:
+            if len(side.base) == 0 and len(side.delta_run) == 0:
+                z = np.zeros(len(q), dtype=np.int64)
+                return z, z
             return self._sharded_base_counts(side, q)
+        if len(side.base) == 0:
+            z = np.zeros(len(q), dtype=np.int64)
+            return z, z
         if self.engine == "jax":
             bb = _next_bucket(len(side.base))
             qb = _next_bucket(len(q))
@@ -331,21 +465,53 @@ class ExactAucIndex:
         """
         from tuplewise_tpu.parallel.sharded_counts import sharded_counts
 
+        from tuplewise_tpu.parallel.sharded_counts import next_bucket
+
+        self._q_buckets.add(next_bucket(len(q)))
+
         def attempt():
+            # at most TWO runs — base + the consolidated delta run —
+            # so compile shapes follow the two bucket ladders alone
+            deltas = (((side.delta_dev, side.delta_cap),)
+                      if side.delta_dev is not None else ())
             return sharded_counts(self._mesh, side.base_dev, side.cap,
-                                  q, self.dtype, chaos=self.chaos)
+                                  q, self.dtype, chaos=self.chaos,
+                                  deltas=deltas)
 
         return self._healer.run(attempt, retries=self.shard_retries,
                                 on_heal=self._on_heal)
 
     def _on_heal(self, healer) -> None:
         """Re-placement after a heal round: adopt the (possibly
-        resharded) mesh and rebuild the device shards from the
-        host-authoritative runs (pure cache rebuild)."""
+        resharded) mesh and rebuild the device shards — base AND delta
+        runs — from the host-authoritative copies (pure cache
+        rebuild)."""
         self._mesh = healer.mesh
         self.shards = healer.n_workers
-        self._place(self._pos)
-        self._place(self._neg)
+        for side in (self._pos, self._neg):
+            side.placed_base = None   # stale mesh: no row reuse
+            self._place(side)
+            self._replace_deltas(side)
+
+    def _replace_deltas(self, side: _ClassSide) -> None:
+        """Rebuild the delta run's device placement (mesh change or
+        snapshot restore)."""
+        if self.shards is None or len(side.delta_run) == 0:
+            side.delta_dev, side.delta_cap = None, 0
+            side.delta_rows = None
+            return
+        from tuplewise_tpu.parallel.sharded_counts import (
+            mesh_size, place_base,
+        )
+
+        side.delta_dev, side.delta_cap, _ = place_base(
+            self._mesh, side.delta_run, self.dtype,
+            metrics=self.metrics)
+        S = mesh_size(self._mesh)
+        per = -(-len(side.delta_run) // S)
+        side.delta_rows = np.clip(
+            len(side.delta_run) - per * np.arange(S), 0, per
+        ).astype(np.int64)
 
     def _counts(self, side: _ClassSide,
                 q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -361,6 +527,16 @@ class ExactAucIndex:
             r2 = np.searchsorted(arr, q, side="right").astype(np.int64)
             less += sign * l2
             eq += sign * (r2 - l2)
+        if len(side.tomb_run):
+            # the consolidated tombstone multiset: already sorted, its
+            # counts subtract — additivity over signed multisets keeps
+            # every prefix exact [ISSUE 5]
+            l2 = np.searchsorted(side.tomb_run, q,
+                                 side="left").astype(np.int64)
+            r2 = np.searchsorted(side.tomb_run, q,
+                                 side="right").astype(np.int64)
+            less -= l2
+            eq -= r2 - l2
         return less, eq
 
     def _cross2(self, p_vals: np.ndarray, n_side: _ClassSide) -> int:
@@ -508,13 +684,15 @@ class ExactAucIndex:
             self._drain_builds(timeout, "background compaction stuck")
 
     def compact(self) -> None:
-        """Force both sides into a single sorted base run (drains any
-        in-flight background builds first)."""
+        """Force both sides into a single sorted base run — folding
+        the delta run and dropping tombstones — after draining any
+        in-flight background builds."""
         with self._cv:
             self._drain_builds(30.0, "background compaction stuck")
             for side in (self._pos, self._neg):
-                if side.buf or side.tomb:
-                    self._compact_side(side)
+                if (side.buf or side.tomb or len(side.delta_run)
+                        or len(side.tomb_run)):
+                    self._full_compact(side)
 
     def _merge(self, side_base: np.ndarray, buf: List[float],
                tomb: List[float], on_thread: bool) -> np.ndarray:
@@ -542,30 +720,253 @@ class ExactAucIndex:
         elif len(buf_sorted) == 0:
             merged = side_base
         else:
-            merged = np.insert(
-                side_base, np.searchsorted(side_base, buf_sorted),
-                buf_sorted)
+            # single-allocation splice [ISSUE 5 satellite]: np.insert
+            # re-copied the base through its generic path
+            merged = _splice_merge(side_base, buf_sorted)
         return _remove_sorted(merged, tomb)
 
-    def _place(self, side: _ClassSide) -> None:
-        """(Re)place the base run's device shards after it changed."""
+    def _place(self, side: _ClassSide) -> int:
+        """(Re)place the base run's device shards after it changed;
+        returns the bytes actually shipped (unchanged rows are reused
+        when the bucket geometry permits [ISSUE 5 satellite])."""
         if self.shards is None or len(side.base) == 0:
             side.base_dev, side.cap = None, 0
-            return
+            side.placed_base = None
+            return 0
         from tuplewise_tpu.parallel.sharded_counts import place_base
 
-        side.base_dev, side.cap = place_base(
-            self._mesh, side.base, self.dtype)
+        side.base_dev, side.cap, shipped = place_base(
+            self._mesh, side.base, self.dtype,
+            prev=(side.placed_base, side.base_dev, side.cap),
+            metrics=self.metrics, chaos=self.chaos)
+        side.placed_base = side.base
+        return shipped
 
+    def _warm_counts(self, base_dev, cap: int, deltas) -> None:
+        """Force-compile the count kernel for a placement geometry the
+        request path is ABOUT to see (called on the compactor thread
+        before the swap, with every query bucket observed so far):
+        XLA compiles of new (base cap, delta cap, q bucket) shapes
+        otherwise land on the first post-swap count — a request-thread
+        pause the background compactor exists to remove."""
+        if base_dev is None and not deltas:
+            return
+        from tuplewise_tpu.parallel.sharded_counts import sharded_counts
+
+        for qb in sorted(self._q_buckets):
+            key = (cap if base_dev is not None else None,
+                   tuple(c for _, c in deltas), qb)
+            if key in self._warmed:
+                continue
+            try:
+                sharded_counts(self._mesh, base_dev, cap,
+                               np.zeros(qb, dtype=self.dtype),
+                               self.dtype, deltas=deltas)
+                self._warmed.add(key)
+            except Exception:   # noqa: BLE001 — warming is best-effort
+                return
+
+    # ------------------------------------------------------------------ #
+    # compaction tiers [ISSUE 5]                                         #
+    # ------------------------------------------------------------------ #
     def _compact_side(self, side: _ClassSide) -> None:
-        """Synchronous compaction (caller holds the lock): the merge —
-        and the pause it bills to the caller — spans the full sort."""
-        t0 = time.perf_counter()
-        side.base = self._merge(side.base, side.buf, side.tomb,
-                                on_thread=True)
+        """Synchronous compaction (caller holds the lock): the work —
+        and the pause it bills to the caller — runs inline. Delta mode
+        makes that pause O(b): a minor compaction, then whatever
+        follow-up tier is due."""
+        if not self._delta:
+            self._full_compact(side)
+            return
+        buf_vals, tomb_vals = list(side.buf), list(side.tomb)
         side.buf = []
         side.tomb = []
-        self._place(side)
+        t0 = time.perf_counter()
+        new_delta, placed = self._build_delta(side, buf_vals)
+        self._commit_minor(side, new_delta, placed, tomb_vals, t0)
+        todo = self._followup(side)
+        if todo == "major":
+            t0 = time.perf_counter()
+            merged, dev, cap = self._major_build(side)
+            self._commit_major(side, merged, dev, cap, t0, t0)
+        elif todo == "full":
+            self._full_compact(side)
+
+    def _build_delta(self, side: _ClassSide, buf_vals: List[float]):
+        """Merge the pending buffer into the consolidated delta run —
+        host copy via the single-allocation splice, DEVICE copy by
+        shipping only the O(b) chunk and rank-merging it into the
+        placed delta rows per shard (``delta_append_fn``; counting is
+        additive over any partition into sorted runs, so per-row
+        unions need no rebalancing). Host→device bytes per minor are
+        O(buffer), independent of both the base and the accumulated
+        delta. Returns (new_delta_host,
+        (dev, cap, bytes, rows) | None). Caller owns the delta state
+        (lock or worker claim)."""
+        chunk = np.sort(np.asarray(buf_vals, dtype=self.dtype))
+        if len(chunk) == 0:
+            return side.delta_run, None     # tomb-only minor
+        new_delta = _splice_merge(side.delta_run, chunk)
+        from tuplewise_tpu.parallel.sharded_counts import (
+            delta_append_fn, mesh_size, next_bucket, place_base,
+        )
+
+        S = mesh_size(self._mesh)
+        if side.delta_dev is None:
+            # first minor after a fold: place the (fresh) run
+            dev, cap, shipped = place_base(
+                self._mesh, new_delta, self.dtype,
+                metrics=self.metrics, chaos=self.chaos)
+            per = -(-len(new_delta) // S)
+            rows = np.clip(len(new_delta) - per * np.arange(S),
+                           0, per).astype(np.int64)
+            return new_delta, (dev, cap, shipped, rows)
+        # append path: ship the chunk, merge rows on device
+        chunk_dev, chunk_cap, shipped = place_base(
+            self._mesh, chunk, self.dtype, metrics=self.metrics,
+            chaos=self.chaos)
+        per_c = -(-len(chunk) // S)
+        rows = side.delta_rows + np.clip(
+            len(chunk) - per_c * np.arange(S), 0, per_c)
+        cap_new = next_bucket(int(rows.max()))
+        dev = delta_append_fn(self._mesh, side.delta_cap, chunk_cap,
+                              cap_new)(side.delta_dev, chunk_dev)
+        return new_delta, (dev, cap_new, shipped, rows)
+
+    def _commit_minor(self, side: _ClassSide, new_delta: np.ndarray,
+                      placed, tomb_vals: List[float],
+                      t0: float) -> None:
+        """Adopt a minor compaction's outputs (lock held): swap the
+        consolidated delta run, fold fresh tombstones into the sorted
+        tombstone multiset. Counts are unchanged by construction — the
+        same values moved between containers whose counts
+        add/subtract."""
+        if placed is not None:
+            dev, cap, shipped, rows = placed
+            side.delta_run = new_delta
+            side.delta_dev, side.delta_cap = dev, cap
+            side.delta_rows = rows
+            side.delta_minors += 1
+            self._h_compaction_bytes.observe(shipped)
+        if tomb_vals:
+            side.tomb_run = _splice_merge(
+                side.tomb_run,
+                np.sort(np.asarray(tomb_vals, dtype=self.dtype)))
+        self.n_compactions += 1
+        self._c_compactions.inc()
+        self._h_pause.observe(time.perf_counter() - t0)
+
+    def _followup(self, side: _ClassSide) -> Optional[str]:
+        """Which heavier tier (if any) a minor compaction leaves due.
+
+        "full" when the tombstone multiset outgrew the base fraction —
+        only a host rebuild can physically drop tombstones; "major"
+        when the delta mass crossed ``delta_fraction·|base|`` or
+        ``max_delta_runs`` minor runs have been merged into it (the
+        bound on per-minor splice-and-re-ship cost).
+        """
+        if len(side.tomb_run) >= max(
+                self.compact_every,
+                int(self.delta_fraction * len(side.base))):
+            return "full"
+        if len(side.delta_run) and (
+                len(side.delta_run)
+                > self.delta_fraction * max(len(side.base), 1)
+                or side.delta_minors >= self.max_delta_runs):
+            return "major"
+        return None
+
+    def _major_build(self, side: _ClassSide):
+        """Fold the delta run into the base; returns the new
+        (merged_host, base_dev, cap). The host copy is the cheap
+        single-allocation splice; the device copy is built ON-MESH
+        (zero host→device bytes) whenever the host plan fits the
+        one-hop neighbor exchange, else by full re-placement
+        [ISSUE 5 tentpole]. Caller owns base/delta (lock or worker
+        claim)."""
+        from tuplewise_tpu.parallel.sharded_counts import (
+            mesh_size, place_base, plan_major_merge, sharded_major_merge,
+        )
+
+        base, base_dev, cap = side.base, side.base_dev, side.cap
+        delta_full = side.delta_run
+        merged = _splice_merge(base, delta_full)
+        if (len(base) and base_dev is not None
+                and side.delta_dev is not None
+                and self.shards is not None and self.shards >= 2):
+            plan = plan_major_merge(base, delta_full,
+                                    mesh_size(self._mesh))
+            if plan.ok:
+                try:
+                    dev, cap_out = sharded_major_merge(
+                        self._mesh, base_dev, cap,
+                        ((side.delta_dev, side.delta_cap),),
+                        plan, chaos=self.chaos)
+                    # the bytes the PR 2 path would have re-shipped
+                    self._c_bytes_saved.inc(
+                        mesh_size(self._mesh) * cap_out
+                        * np.dtype(self.dtype).itemsize)
+                    return merged, dev, cap_out
+                except Exception as e:   # noqa: BLE001 — fallback path
+                    self._c_major_fb.inc()
+                    self.last_major_merge_error = repr(e)
+        # S=1 / empty-base / out-of-plan / failed-mesh fallback: the
+        # host engine re-places the merged run in full
+        dev, cap_out, _ = place_base(self._mesh, merged, self.dtype,
+                                     metrics=self.metrics)
+        return merged, dev, cap_out
+
+    def _commit_major(self, side: _ClassSide, merged: np.ndarray,
+                      dev, cap: int, t_build0: float,
+                      t_pause0: float) -> None:
+        """Swap a major merge in (lock held): rebind base, clear the
+        folded delta run (no newer delta can exist — the side is
+        owned for the whole job), keep tombstones (counts still
+        subtract them)."""
+        side.base = merged
+        side.placed_base = merged
+        side.base_dev, side.cap = dev, cap
+        side.delta_run = np.empty(0, dtype=self.dtype)
+        side.delta_dev, side.delta_cap = None, 0
+        side.delta_rows = None
+        side.delta_minors = 0
+        self.n_compactions += 1
+        self._c_compactions.inc()
+        self.n_major_merges += 1
+        self._c_major.inc()
+        now = time.perf_counter()
+        self._h_major.observe(now - t_build0)
+        self._h_pause.observe(now - t_pause0)
+
+    def _full_compact(self, side: _ClassSide) -> None:
+        """Fold EVERYTHING — base, delta run, buffer — into one sorted
+        base run, physically dropping tombstones, and re-place (caller
+        holds the lock). The PR 2 engine, kept as the explicit
+        ``compact()`` semantics, the non-delta compaction, and the
+        tombstone-overflow rebuild."""
+        t0 = time.perf_counter()
+        tombs = side.tomb_run.tolist() + side.tomb
+        if len(side.delta_run):
+            merged = _remove_sorted(
+                _splice_merge(
+                    _splice_merge(side.base, side.delta_run),
+                    np.sort(np.asarray(side.buf, dtype=self.dtype))),
+                tombs)
+        else:
+            merged = self._merge(side.base, side.buf, tombs,
+                                 on_thread=True)
+        side.base = merged
+        side.buf = []
+        side.tomb = []
+        side.delta_run = np.empty(0, dtype=self.dtype)
+        side.delta_dev, side.delta_cap = None, 0
+        side.delta_rows = None
+        side.delta_minors = 0
+        side.tomb_run = np.empty(0, dtype=self.dtype)
+        shipped = self._place(side)
+        if not self._delta:
+            # in host-merge mode this IS the minor compaction — the
+            # bytes histogram is what the delta mode is judged against
+            self._h_compaction_bytes.observe(shipped)
         self.n_compactions += 1
         self._c_compactions.inc()
         self._h_pause.observe(time.perf_counter() - t0)
@@ -612,8 +1013,12 @@ class ExactAucIndex:
             self._bg_test_hook(side)
         if self.chaos is not None:
             self.chaos.fire("compactor_build")
+        if self._delta:
+            self._bg_delta_build(side)
+            return
         with self._cv:
             base = side.base
+            prev = (side.placed_base, side.base_dev, side.cap)
             buf_snap = list(side.buf[: side.snap_buf])
             tomb_snap = list(side.tomb[: side.snap_tomb])
         # the expensive part — merge + device placement — runs with
@@ -623,13 +1028,19 @@ class ExactAucIndex:
         if self.shards is not None and len(merged):
             from tuplewise_tpu.parallel.sharded_counts import place_base
 
-            base_dev, cap = place_base(self._mesh, merged, self.dtype)
+            base_dev, cap, shipped = place_base(
+                self._mesh, merged, self.dtype, prev=prev,
+                metrics=self.metrics, chaos=self.chaos)
         else:
-            base_dev, cap = None, 0
+            base_dev, cap, shipped = None, 0, 0
+        self._warm_counts(base_dev, cap, ())
         with self._cv:
             t0 = time.perf_counter()
             side.base = merged
             side.base_dev, side.cap = base_dev, cap
+            side.placed_base = merged if base_dev is not None else None
+            if self.shards is not None:
+                self._h_compaction_bytes.observe(shipped)
             del side.buf[: side.snap_buf]
             del side.tomb[: side.snap_tomb]
             side.snap_buf = side.snap_tomb = 0
@@ -640,6 +1051,78 @@ class ExactAucIndex:
             self._h_pause.observe(time.perf_counter() - t0)
             # keep draining if the buffer outgrew the threshold
             # while this build ran
+            buf_pending, tomb_pending = side.pending
+            if (not self._closed
+                    and (buf_pending >= self.compact_every
+                         or tomb_pending >= self.compact_every)):
+                self._submit_compact(side)
+            self._cv.notify_all()
+
+    def _bg_delta_build(self, side: _ClassSide) -> None:
+        """Delta-mode background job [ISSUE 5]: an O(b) minor build +
+        swap, then — still on the worker thread, with the side still
+        claimed (``building``) — whatever heavier tier fell due. The
+        request path's only pauses are the atomic swaps; inserts keep
+        landing in the (unclaimed) buffer throughout."""
+        with self._cv:
+            buf_snap = list(side.buf[: side.snap_buf])
+            tomb_snap = list(side.tomb[: side.snap_tomb])
+        # O(|delta| + b log b) splice + O(|delta|) placement, lock
+        # released (the worker owns delta_run for the whole job)
+        new_delta, placed = self._build_delta(side, buf_snap)
+        if placed is not None:
+            self._warm_counts(side.base_dev, side.cap,
+                              ((placed[0], placed[1]),))
+        with self._cv:
+            t0 = time.perf_counter()
+            self._commit_minor(side, new_delta, placed, tomb_snap, t0)
+            del side.buf[: side.snap_buf]
+            del side.tomb[: side.snap_tomb]
+            side.snap_buf = side.snap_tomb = 0
+            todo = self._followup(side)
+        # base/delta_run/tomb_run stay worker-owned until the job
+        # ends: _submit_compact refuses new claims while building, and
+        # the watchdog's sync fallback skips building sides
+        if todo == "major":
+            t0 = time.perf_counter()
+            merged, dev, cap = self._major_build(side)
+            self._warm_counts(dev, cap, ())
+            with self._cv:
+                self._commit_major(side, merged, dev, cap, t0,
+                                   time.perf_counter())
+        elif todo == "full":
+            # tombstone overflow: host rebuild of base ⊕ delta minus
+            # the tombstone multiset, leaving the (unclaimed) buffer
+            # and pending tombstones alone
+            merged = _remove_sorted(
+                _splice_merge(side.base, side.delta_run),
+                side.tomb_run.tolist())
+            if len(merged):
+                from tuplewise_tpu.parallel.sharded_counts import (
+                    place_base,
+                )
+
+                dev, cap, _ = place_base(self._mesh, merged, self.dtype,
+                                         metrics=self.metrics,
+                                         chaos=self.chaos)
+            else:
+                dev, cap = None, 0
+            self._warm_counts(dev, cap, ())
+            with self._cv:
+                t0 = time.perf_counter()
+                side.base = merged
+                side.base_dev, side.cap = dev, cap
+                side.placed_base = merged if dev is not None else None
+                side.delta_run = np.empty(0, dtype=self.dtype)
+                side.delta_dev, side.delta_cap = None, 0
+                side.delta_rows = None
+                side.delta_minors = 0
+                side.tomb_run = np.empty(0, dtype=self.dtype)
+                self.n_compactions += 1
+                self._c_compactions.inc()
+                self._h_pause.observe(time.perf_counter() - t0)
+        with self._cv:
+            side.building = False
             buf_pending, tomb_pending = side.pending
             if (not self._closed
                     and (buf_pending >= self.compact_every
@@ -721,4 +1204,16 @@ class ExactAucIndex:
                 "shards": self.shards,
                 "bg_compact": self.bg_compact,
                 "last_compactor_error": self.last_compactor_error,
+                # delta-compaction state [ISSUE 5]
+                "delta_compact": self._delta,
+                "delta_runs": (self._pos.delta_minors
+                               + self._neg.delta_minors),
+                "delta_events": (len(self._pos.delta_run)
+                                 + len(self._neg.delta_run)),
+                "tombstones": (len(self._pos.tomb_run)
+                               + len(self._neg.tomb_run)
+                               + len(self._pos.tomb)
+                               + len(self._neg.tomb)),
+                "n_major_merges": self.n_major_merges,
+                "last_major_merge_error": self.last_major_merge_error,
             }

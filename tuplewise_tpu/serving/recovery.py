@@ -7,7 +7,8 @@ are a function of the admitted event sequence, independent of batching
 decomposes into two durable artifacts:
 
 * **Snapshot** — a single-file ``.npz`` of the full estimator state
-  (base runs, buffers, tombstones, arrival log, wins2 as a decimal
+  (base runs, delta runs + tombstone multiset [ISSUE 5], buffers,
+  tombstones, arrival log, wins2 as a decimal
   string — it is an unbounded Python int — plus the incomplete-U sums,
   reservoirs, and host RNG state via ``utils.rng.capture_np_rng``),
   written through ``utils.checkpoint.save_checkpoint`` (fsync'd temp +
@@ -195,6 +196,17 @@ def capture_snapshot_state(engine) -> Tuple[dict, dict]:
                                                   dtype=idx.dtype)
                 extra[f"{name}_tomb"] = np.asarray(side.tomb,
                                                    dtype=idx.dtype)
+                # delta-compaction state [ISSUE 5]: the host-
+                # authoritative consolidated delta run (plus its
+                # fold-trigger minor count) and the sorted tombstone
+                # multiset; device placements are a pure cache rebuilt
+                # on restore
+                extra[f"{name}_delta_run"] = np.asarray(
+                    side.delta_run, dtype=idx.dtype)
+                extra[f"{name}_delta_minors"] = np.asarray(
+                    [side.delta_minors], dtype=np.int64)
+                extra[f"{name}_tomb_run"] = np.asarray(
+                    side.tomb_run, dtype=idx.dtype)
             extra["log_scores"] = np.asarray(
                 [v for v, _ in idx._log], dtype=idx.dtype)
             extra["log_labels"] = np.asarray(
@@ -204,6 +216,7 @@ def capture_snapshot_state(engine) -> Tuple[dict, dict]:
             cfg["wins2"] = str(idx._wins2)
             cfg["n_compactions"] = idx.n_compactions
             cfg["n_evicted"] = idx.n_evicted
+            cfg["n_major_merges"] = idx.n_major_merges
     st = engine.streaming
     extra["stream_sums"] = np.asarray([st._sum_h, st._sum_h2],
                                       dtype=np.float64)
@@ -251,14 +264,28 @@ def restore_snapshot(directory: str, engine) -> Optional[int]:
                     idx.dtype).tolist()
                 side.tomb = extra[f"{name}_tomb"].astype(
                     idx.dtype).tolist()
+                # delta run + tombstone multiset [ISSUE 5]; absent in
+                # pre-delta snapshots (empty defaults keep them loadable)
+                dr = extra.get(f"{name}_delta_run")
+                side.delta_run = (dr.astype(idx.dtype)
+                                  if dr is not None
+                                  else np.empty(0, dtype=idx.dtype))
+                dm = extra.get(f"{name}_delta_minors")
+                side.delta_minors = int(dm[0]) if dm is not None else 0
+                tr = extra.get(f"{name}_tomb_run")
+                side.tomb_run = (tr.astype(idx.dtype) if tr is not None
+                                 else np.empty(0, dtype=idx.dtype))
             idx._log = collections.deque(zip(
                 extra["log_scores"].astype(idx.dtype).tolist(),
                 [bool(b) for b in extra["log_labels"]]))
             idx._wins2 = int(cfg["wins2"])
             idx.n_compactions = int(cfg["n_compactions"])
             idx.n_evicted = int(cfg["n_evicted"])
-            idx._place(idx._pos)
-            idx._place(idx._neg)
+            idx.n_major_merges = int(cfg.get("n_major_merges", 0))
+            for side in (idx._pos, idx._neg):
+                side.placed_base = None   # force a fresh placement
+                idx._place(side)
+                idx._replace_deltas(side)
     st = engine.streaming
     st._sum_h, st._sum_h2 = (float(x) for x in extra["stream_sums"])
     st._n_terms, st.n_arrivals = (int(x) for x in extra["stream_counts"])

@@ -130,6 +130,8 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     lat = stats["metrics"]["request_latency_s"]
     ins = stats["metrics"].get("insert_latency_s", {})
     pause = stats["metrics"].get("compaction_pause_s", {})
+    cbytes = stats["metrics"].get("compaction_bytes", {})
+    major = stats["metrics"].get("major_merge_s", {})
     fill = stats["metrics"]["batch_fill"]
     applied = stats["metrics"]["events_total"]["value"]
 
@@ -156,6 +158,19 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
         "compactions": pause.get("count", 0),
         "compaction_pause_p99_ms": _ms(pause, "p99"),
         "compaction_pause_max_ms": _ms(pause, "max"),
+        # transfer accounting [ISSUE 5]: the host->device byte budget
+        # of the index's compaction tiers — the serving-side analogue
+        # of the paper's shuffle-bytes axis
+        "bytes_h2d": stats["metrics"].get(
+            "bytes_h2d", {}).get("value", 0),
+        "bytes_h2d_saved": stats["metrics"].get(
+            "bytes_h2d_saved", {}).get("value", 0),
+        "bytes_per_compaction": cbytes.get("mean"),
+        "major_merges": stats["metrics"].get(
+            "major_merges_total", {}).get("value", 0),
+        "major_merge_fallbacks": stats["metrics"].get(
+            "major_merge_fallbacks", {}).get("value", 0),
+        "major_merge_p99_ms": _ms(major, "p99"),
         "batches": stats["metrics"]["batches_total"]["value"],
         "mean_batch_fill": fill["mean"],
         "auc_exact": stats.get("auc_exact"),
@@ -171,6 +186,8 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             "queue_size": cfg.queue_size, "policy": cfg.policy,
             "engine": cfg.engine, "chunk": chunk,
             "mesh_shards": cfg.mesh_shards, "bg_compact": cfg.bg_compact,
+            "delta_fraction": cfg.delta_fraction,
+            "max_delta_runs": cfg.max_delta_runs,
         },
     }
     if injector is not None:

@@ -16,6 +16,12 @@ serving points (ISSUE 3):
                           how a dead device actually surfaces);
     ``compactor_build`` — the background compactor's build step in
                           ``serving/index.py``;
+    ``place_base``      — a device placement in
+                          ``parallel.sharded_counts.place_base``;
+    ``major_merge``     — the on-mesh delta fold in
+                          ``parallel.sharded_counts.sharded_major_merge``
+                          (a raise here exercises the index's host
+                          fallback engine) [ISSUE 5];
     ``batcher``         — the micro-batch engine's worker loop in
                           ``serving/engine.py``;
     ``poison``          — event corruption (NaN/inf scores) applied to
@@ -69,8 +75,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 _POINTS = ("sharded_count", "compactor_build", "batcher", "place_base",
-           "train_step", "mc_chunk", "mesh_mc", "estimator",
-           "checkpoint", "dist_init")
+           "major_merge", "train_step", "mc_chunk", "mesh_mc",
+           "estimator", "checkpoint", "dist_init")
 _ACTIONS = ("error", "delay", "sigkill")
 
 
@@ -220,8 +226,8 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGKILL)
         if errors:
             exc = (InjectedDeviceError if point in
-                   ("sharded_count", "place_base", "mesh_mc",
-                    "train_step", "mc_chunk", "estimator")
+                   ("sharded_count", "place_base", "major_merge",
+                    "mesh_mc", "train_step", "mc_chunk", "estimator")
                    else InjectedFault)
             raise exc(
                 f"chaos: injected {point} fault (call #{errors[0].on_call})")

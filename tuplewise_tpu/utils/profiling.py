@@ -103,6 +103,11 @@ _DEFAULT_BUCKETS = tuple(
     for b in (1.0, 2.5, 5.0)
 )
 
+# Byte-sized histograms (transfer accounting [ISSUE 5]): powers of 4
+# from 256 B to 16 GiB — compaction transfers span KBs (delta runs) to
+# GBs (full base re-placements at 10^8).
+BYTE_BUCKETS = tuple(256 * 4 ** i for i in range(13))
+
 
 class Histogram:
     """Fixed-bucket histogram with exact-sample percentile estimates.
