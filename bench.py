@@ -394,7 +394,8 @@ def _batch_chaos_record(spec=None):
 def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
                               window=None, baseline_events=2_000,
                               bg_compact=True, max_inflight=64,
-                              flush_timeout_s=0.0005, chaos=None):
+                              flush_timeout_s=0.0005, chaos=None,
+                              obs=None):
     """Micro-batched serving throughput + unbatched baseline + the
     on-thread-compaction latency comparison.
 
@@ -416,8 +417,12 @@ def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
     cfg = ServingConfig(budget=budget, max_batch=max_batch, window=window,
                         policy="block", flush_timeout_s=flush_timeout_s,
                         compact_every=1024, bg_compact=bg_compact)
+    # observability [ISSUE 6]: only the MAIN timed run is traced /
+    # metric-streamed / profiled; baseline + sync comparison runs stay
+    # bare so their numbers measure the engine, not the instruments
+    obs = obs or {}
     rec = replay(scores, labels, config=cfg, warmup=True,
-                 max_inflight=max_inflight, chaos=chaos)
+                 max_inflight=max_inflight, chaos=chaos, **obs)
     print(
         f"[bench] streaming n={n_events} batched (bg_compact="
         f"{bg_compact}): "
@@ -460,12 +465,20 @@ def _streaming_main(args):
 
         chaos = FaultInjector.from_spec(
             args.chaos_spec or _CHAOS_BENCH_SPEC)
+    obs = {}
+    if args.trace_out:
+        obs["trace_out"] = args.trace_out
+    if args.metrics_out:
+        obs["metrics_out"] = args.metrics_out
+        obs["metrics_every_s"] = args.metrics_every
+    if args.profile_dir:
+        obs["profile_dir"] = args.profile_dir
     rec, base, sync = _streaming_events_per_sec(
         n_events=args.n_events, budget=args.budget,
         max_batch=args.max_batch, window=args.window,
         baseline_events=args.baseline_events,
         bg_compact=not args.sync_compact,
-        max_inflight=args.max_inflight, chaos=chaos,
+        max_inflight=args.max_inflight, chaos=chaos, obs=obs,
     )
     out = {
         "metric": "events/sec",
@@ -483,6 +496,12 @@ def _streaming_main(args):
         "insert_latency_p99_ms": rec["insert_latency_p99_ms"],
         "compactions": rec["compactions"],
         "compaction_pause_p99_ms": rec["compaction_pause_p99_ms"],
+        # per-stage p99 attribution [ISSUE 6]: where the insert p99
+        # actually goes (queue wait vs index vs wal vs snapshot)
+        "insert_stage_p99_ms": rec.get("insert_stage_p99_ms"),
+        "stage_attribution": rec.get("stage_attribution"),
+        "trace_out": rec.get("trace_out"),
+        "metrics_out": rec.get("metrics_out"),
         "bg_compact": not args.sync_compact,
         "max_inflight": args.max_inflight,
         "mean_batch_fill": rec["mean_batch_fill"],
@@ -566,6 +585,17 @@ def main():
     ap.add_argument("--chaos-spec", type=str, default=None,
                     help="override the default --chaos schedule (JSON "
                          "inline, @file, or *.json path)")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="with --streaming: export the span trace of "
+                         "the main timed run (*.jsonl = span JSONL, "
+                         "else Chrome trace JSON for perfetto)")
+    ap.add_argument("--metrics-out", type=str, default=None,
+                    help="with --streaming: stream periodic registry "
+                         "snapshots (JSONL) during the main run")
+    ap.add_argument("--metrics-every", type=float, default=1.0)
+    ap.add_argument("--profile-dir", type=str, default=None,
+                    help="with --streaming: bracket the main run in a "
+                         "jax.profiler trace written here")
     args = ap.parse_args()
     if args.streaming:
         _streaming_main(args)

@@ -56,4 +56,16 @@ if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 # writes results/preemption_smoke.jsonl for the CI artifact.
 timeout -k 10 420 env JAX_PLATFORMS=cpu \
     python scripts/preemption_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Observability smoke [ISSUE 6]: a traced chaos replay must produce a
+# schema-valid Chrome/perfetto trace whose per-stage spans sum to the
+# measured insert latency (>= 95% per trace), a metrics.jsonl with >= 2
+# periodic registry snapshots, and a flight-recorder dump in which
+# every injected fault / compaction / heal appears exactly once with a
+# correlating trace id; the trace/metrics/flight files land under
+# results/ for the CI artifact.
+timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/obs_smoke.py
 exit $?

@@ -1,0 +1,234 @@
+"""CI observability smoke [ISSUE 6] — the acceptance harness.
+
+A traced replay of a chaos schedule must produce, in one run:
+
+(a) a perfetto-loadable Chrome trace whose per-stage spans sum to
+    >= 95% of each measured insert latency (they tile the request's
+    lifetime, so the real figure is ~100%);
+(b) a ``metrics.jsonl`` with >= 2 periodic whole-registry snapshots,
+    each stamped with wall+monotonic timestamps, platform, and config
+    digest;
+(c) a flight-recorder dump in which every injected fault and every
+    compaction / major-merge / heal event appears exactly once, with a
+    correlating (non-null) trace id on each injected fault —
+
+while the span-JSONL export stays digestible by
+``scripts/trace_summary.py``. Any breach exits nonzero; the summary
+row (stage "obs_smoke") lands in a JSONL the workflow uploads.
+
+Usage: python scripts/obs_smoke.py [--n-events 4000]
+                                   [--out results/obs_smoke.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHAOS = {"faults": [
+    {"point": "compactor_build", "on_call": 1, "action": "error"},
+    {"point": "batcher", "on_call": 15, "action": "error"},
+    {"point": "poison", "at_events": [150, 900], "value": "nan"},
+]}
+
+
+def _fail(msg: str) -> int:
+    print(f"OBS SMOKE FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _check_chrome(path: str) -> int:
+    """Chrome trace-event schema: the contract perfetto loads."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        return _fail("trace has no traceEvents list")
+    n_x = 0
+    for e in evs:
+        if not isinstance(e, dict) or "ph" not in e:
+            return _fail(f"malformed trace event: {e!r}")
+        if e["ph"] == "X":
+            n_x += 1
+            for k in ("name", "pid", "tid", "ts", "dur"):
+                if k not in e:
+                    return _fail(f"X event missing {k!r}: {e!r}")
+            if not (isinstance(e["ts"], (int, float))
+                    and isinstance(e["dur"], (int, float))
+                    and e["dur"] >= 0):
+                return _fail(f"X event bad ts/dur: {e!r}")
+        elif e["ph"] == "M":
+            if "name" not in e or "args" not in e:
+                return _fail(f"M event missing name/args: {e!r}")
+    if n_x == 0:
+        return _fail("trace has no complete (X) events")
+    print(f"  chrome trace OK: {n_x} X events", file=sys.stderr)
+    return 0
+
+
+def _check_stage_sums(spans_path: str) -> int:
+    """Per-insert attribution: child stage spans must sum to >= 95% of
+    each request.insert root span's duration."""
+    from scripts.trace_summary import load_spans
+
+    spans = load_spans(spans_path)
+    children = {}
+    for s in spans:
+        if s.get("parent_id") is not None:
+            children.setdefault(s["parent_id"], 0.0)
+            children[s["parent_id"]] += s["dur_s"]
+    roots = [s for s in spans if s["name"] == "request.insert"
+             and s["parent_id"] is None]
+    if not roots:
+        return _fail("no request.insert root spans in the trace")
+    bad = 0
+    for r in roots:
+        if r["dur_s"] <= 0:
+            continue
+        cov = children.get(r["span_id"], 0.0) / r["dur_s"]
+        if cov < 0.95:
+            bad += 1
+    if bad:
+        return _fail(f"{bad}/{len(roots)} insert traces have stage "
+                     f"spans summing to < 95% of the measured latency")
+    print(f"  stage sums OK: {len(roots)} insert traces all >= 95%",
+          file=sys.stderr)
+    return 0
+
+
+def _check_metrics(path: str) -> int:
+    rows = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if len(rows) < 2:
+        return _fail(f"metrics.jsonl has {len(rows)} snapshots (< 2)")
+    for r in rows:
+        for k in ("seq", "ts_wall", "ts_mono", "platform",
+                  "config_digest", "metrics"):
+            if k not in r:
+                return _fail(f"metrics row missing {k!r}")
+    if rows[-1]["metrics"].get("events_total", {}).get("value", 0) < 1:
+        return _fail("final metrics snapshot shows no applied events")
+    print(f"  metrics OK: {len(rows)} snapshots", file=sys.stderr)
+    return 0
+
+
+def _check_flight(path: str, rec: dict) -> int:
+    from tuplewise_tpu.obs.flight import FlightRecorder
+
+    dump = FlightRecorder.load_dump(path)
+    evs = dump["events"]
+    kinds = {}
+    for e in evs:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    # every injected fault appears exactly once, with a trace id
+    injected = [e for e in evs if e["kind"] == "chaos_inject"]
+    scheduled = [f for f in CHAOS["faults"] if f["point"] != "poison"]
+    if len(injected) != len(scheduled):
+        return _fail(f"{len(injected)} chaos_inject events for "
+                     f"{len(scheduled)} scheduled faults")
+    seen_points = sorted(e["point"] for e in injected)
+    if seen_points != sorted(f["point"] for f in scheduled):
+        return _fail(f"chaos points mismatch: {seen_points}")
+    for e in injected:
+        if e.get("trace_id") is None:
+            return _fail(f"chaos_inject without a trace id: {e}")
+    # every compaction / major merge / heal appears exactly once:
+    # the flight counts must equal the metric counters
+    m = rec["report"]
+    pairs = (("compaction-ish", kinds.get("compaction", 0)
+              + kinds.get("major_merge", 0), m["compactions_total"]),
+             ("major_merge", kinds.get("major_merge", 0),
+              m["major_merges_total"]),
+             ("heal", kinds.get("heal", 0), m["reshard_events"]))
+    for name, n_flight, n_metric in pairs:
+        if n_flight != n_metric:
+            return _fail(f"{name}: {n_flight} flight events vs "
+                         f"{n_metric} counted")
+    # sequence numbers are strictly increasing (ring integrity)
+    seqs = [e["seq"] for e in evs]
+    if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+        return _fail("flight sequence numbers not strictly increasing")
+    print(f"  flight OK: {kinds}", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-events", type=int, default=4_000)
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "obs_smoke.jsonl"))
+    ap.add_argument("--results-dir", type=str,
+                    default=os.path.join(REPO, "results"))
+    args = ap.parse_args(argv)
+    os.makedirs(args.results_dir, exist_ok=True)
+    trace_json = os.path.join(args.results_dir, "obs_trace.json")
+    spans_jsonl = os.path.join(args.results_dir, "obs_spans.jsonl")
+    metrics_out = os.path.join(args.results_dir, "metrics.jsonl")
+    flight_out = os.path.join(args.results_dir, "obs_flight.jsonl")
+    for p in (trace_json, spans_jsonl, metrics_out, flight_out):
+        if os.path.exists(p):
+            os.unlink(p)
+
+    from tuplewise_tpu.obs.tracing import Tracer
+    from tuplewise_tpu.serving import ServingConfig
+    from tuplewise_tpu.serving.replay import make_stream, replay
+
+    scores, labels = make_stream(args.n_events, pos_frac=0.5,
+                                 separation=1.0, seed=0)
+    cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
+                        compact_every=256, bg_compact=True)
+    tracer = Tracer(capacity=1 << 17)
+    rec = replay(scores, labels, config=cfg, max_inflight=256,
+                 chaos=CHAOS, tracer=tracer, trace_out=trace_json,
+                 metrics_out=metrics_out, metrics_every_s=0.2,
+                 flight_out=flight_out)
+    tracer.export_jsonl(spans_jsonl)
+    if tracer.dropped:
+        return _fail(f"tracer ring dropped {tracer.dropped} spans — "
+                     "raise capacity, the checks below would lie")
+
+    rc = (_check_chrome(trace_json)
+          or _check_stage_sums(spans_jsonl)
+          or _check_metrics(metrics_out)
+          or _check_flight(flight_out, rec))
+    if rc:
+        return rc
+
+    # the summarizer must digest both exports (the CI artifact a
+    # reviewer actually reads)
+    from scripts.trace_summary import summarize_spans
+
+    summary = summarize_spans(spans_jsonl, 10)
+    summarize_spans(trace_json, 5)
+    print(summary, file=sys.stderr)
+
+    row = {
+        "stage": "obs_smoke",
+        "n_events": args.n_events,
+        "events_per_s": rec["events_per_s"],
+        "insert_stage_p99_ms": rec["insert_stage_p99_ms"],
+        "stage_coverage": rec["stage_attribution"]["coverage"],
+        "trace_spans": rec["trace_spans"],
+        "flight_events": rec["flight_events"],
+        "auc_abs_err": rec.get("auc_abs_err"),
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        f.write(json.dumps(row) + "\n")
+    print(f"obs smoke OK: {rec['trace_spans']} spans, coverage="
+          f"{row['stage_coverage']:.6f}, flight={rec['flight_events']}"
+          f" -> {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

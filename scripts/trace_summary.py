@@ -1,13 +1,22 @@
-"""Summarize a jax.profiler trace directory into a small text table.
+"""Summarize a trace into a small text table.
 
-The profiler writes perfetto JSON under
-``<dir>/plugins/profile/<run>/*.trace.json.gz``; this digests the
-device-side complete events ("ph" == "X") into per-op totals so the
-ring hot-loop profile can be committed as text (RESULTS.md) and diffed
-across rounds [VERDICT r1 next #10] — the raw trace is too big and too
-opaque to review.
+Three input shapes, auto-detected:
 
-Usage: python scripts/trace_summary.py results/trace_mesh_complete [top_n]
+* a **directory** — a ``jax.profiler`` trace
+  (``<dir>/plugins/profile/<run>/*.trace.json.gz``): device-side
+  complete events digested into per-op totals so the ring hot-loop
+  profile can be committed as text (RESULTS.md) and diffed across
+  rounds [VERDICT r1 next #10];
+* a ``*.jsonl`` **file** — the span JSONL exported by
+  ``obs.tracing.Tracer.export_jsonl`` (``--trace-out x.jsonl``)
+  [ISSUE 6]: top spans by SELF time (total minus child time — the
+  number that says where the wall-clock actually went, not how deep
+  the span nests), plus a per-stage insert-latency p99 table;
+* a ``*.json`` **file** — the Chrome trace-event export
+  (``--trace-out x.json``): same summary, read from the ``X`` events'
+  embedded span/parent ids.
+
+Usage: python scripts/trace_summary.py <dir | spans.jsonl | trace.json> [top_n]
 """
 
 from __future__ import annotations
@@ -74,7 +83,110 @@ def summarize(trace_dir: str, top_n: int = 15) -> str:
     return "\n".join(lines)
 
 
+# --------------------------------------------------------------------- #
+# span-trace summaries [ISSUE 6]                                         #
+# --------------------------------------------------------------------- #
+
+def load_spans(path: str):
+    """Spans as dicts with trace_id/span_id/parent_id/name/t0_s/dur_s,
+    from either the span JSONL or the Chrome trace-event export."""
+    if path.endswith(".jsonl"):
+        spans = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if "meta" in rec:
+                    continue
+                spans.append(rec)
+        return spans
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    spans = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args", {})
+        spans.append({
+            "trace_id": args.get("trace_id"),
+            "span_id": args.get("span_id"),
+            "parent_id": args.get("parent_id"),
+            "name": e["name"],
+            "t0_s": e["ts"] / 1e6,
+            "dur_s": e.get("dur", 0.0) / 1e6,
+        })
+    return spans
+
+
+def _quantile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def summarize_spans(path: str, top_n: int = 15) -> str:
+    spans = load_spans(path)
+    if not spans:
+        raise ValueError(f"{path!r} contains no spans")
+    # self time: total minus the time of DIRECT children — the honest
+    # "where did the wall-clock go" attribution
+    child_time = defaultdict(float)
+    for s in spans:
+        if s.get("parent_id") is not None:
+            child_time[s["parent_id"]] += s["dur_s"]
+    agg = defaultdict(lambda: {"n": 0, "total": 0.0, "self": 0.0,
+                               "durs": []})
+    for s in spans:
+        a = agg[s["name"]]
+        a["n"] += 1
+        a["total"] += s["dur_s"]
+        a["self"] += max(0.0, s["dur_s"] - child_time.get(s["span_id"],
+                                                          0.0))
+        a["durs"].append(s["dur_s"])
+    n_traces = len({s["trace_id"] for s in spans})
+    t0 = min(s["t0_s"] for s in spans)
+    t1 = max(s["t0_s"] + s["dur_s"] for s in spans)
+    lines = [
+        f"trace: {path}",
+        f"spans: {len(spans)}  traces: {n_traces}  "
+        f"span window: {t1 - t0:.3f}s",
+        "",
+        f"{'span (by self time)':<34} {'n':>7} {'self_ms':>10} "
+        f"{'total_ms':>10} {'p99_ms':>9}",
+    ]
+    by_self = sorted(agg.items(), key=lambda kv: -kv[1]["self"])
+    for name, a in by_self[:top_n]:
+        nm = name if len(name) <= 33 else name[:30] + "..."
+        lines.append(
+            f"{nm:<34} {a['n']:>7} {a['self'] * 1e3:>10.2f} "
+            f"{a['total'] * 1e3:>10.2f} "
+            f"{_quantile(a['durs'], 0.99) * 1e3:>9.3f}")
+    # per-stage insert-latency table: the insert.* children tile each
+    # request's lifetime, so these p99s ARE the latency decomposition
+    stages = {n: a for n, a in agg.items() if n.startswith("insert.")}
+    if stages:
+        lines += ["", f"{'insert stage':<24} {'n':>7} {'p50_ms':>9} "
+                      f"{'p99_ms':>9} {'max_ms':>9}"]
+        for name, a in sorted(stages.items(),
+                              key=lambda kv: -kv[1]["total"]):
+            lines.append(
+                f"{name:<24} {a['n']:>7} "
+                f"{_quantile(a['durs'], 0.5) * 1e3:>9.3f} "
+                f"{_quantile(a['durs'], 0.99) * 1e3:>9.3f} "
+                f"{max(a['durs']) * 1e3:>9.3f}")
+    return "\n".join(lines)
+
+
 if __name__ == "__main__":
     d = sys.argv[1]
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 15
-    print(summarize(d, n))
+    if os.path.isdir(d):
+        print(summarize(d, n))
+    else:
+        print(summarize_spans(d, n))

@@ -594,3 +594,70 @@ class TestDeltaRecovery:
         ref = ExactAucIndex(engine="jax", compact_every=64, window=400)
         ref.insert_batch(scores.astype(np.float32), labels)
         assert final["auc_exact"] == ref.auc()
+
+
+# --------------------------------------------------------------------- #
+# chaos <-> flight-recorder correlation [ISSUE 6 satellite]              #
+# --------------------------------------------------------------------- #
+class TestChaosFlightCorrelation:
+    def test_every_injected_fault_in_dump_with_trace_id(self, tmp_path):
+        """Each chaos trigger must appear exactly once in the flight
+        dump, carrying a trace id that resolves into the exported span
+        trace — chaos is forensically attributable, not just counted."""
+        from tuplewise_tpu.obs import FlightRecorder, Tracer
+
+        scores, labels = _stream(2500, seed=21)
+        spec = {"faults": [
+            {"point": "compactor_build", "on_call": 1, "action": "error"},
+            {"point": "batcher", "on_call": 9, "action": "error"},
+            {"point": "sharded_count", "on_call": 30, "action": "error",
+             "dropped": [1]},
+            {"point": "poison", "at_events": [40, 1800], "value": "nan"},
+        ]}
+        tracer = Tracer(capacity=1 << 16)
+        flight_out = str(tmp_path / "flight.jsonl")
+        cfg = ServingConfig(policy="block", compact_every=128,
+                            bg_compact=True, mesh_shards=2,
+                            flush_timeout_s=0.001)
+        rec = replay(scores, labels, config=cfg, max_inflight=128,
+                     chaos=spec, tracer=tracer, flight_out=flight_out)
+        dump = FlightRecorder.load_dump(flight_out)
+        evs = dump["events"]
+        injected = [e for e in evs if e["kind"] == "chaos_inject"]
+        fired = rec["faults"]["chaos"]["fired"]
+        # exactly one dump event per fired fault, matching points
+        assert sorted(e["point"] for e in injected) \
+            == sorted(p for p, n in fired.items() for _ in range(n))
+        trace_ids = {s["trace_id"] for s in tracer.spans()}
+        spans_by_trace = {}
+        for s in tracer.spans():
+            spans_by_trace.setdefault(s["trace_id"], []).append(s)
+        for e in injected:
+            assert e["trace_id"] is not None, e
+        # faults that fire INSIDE traced work correlate to the span
+        # that was active at the injection site
+        by_point = {e["point"]: e for e in injected}
+        cb = by_point["compactor_build"]
+        assert cb["trace_id"] in trace_ids
+        assert any(s["name"] == "compactor.build"
+                   for s in spans_by_trace[cb["trace_id"]])
+        sc = by_point["sharded_count"]
+        assert sc["trace_id"] in trace_ids
+        names = {s["name"] for s in spans_by_trace[sc["trace_id"]]}
+        assert "index.sharded_count" in names
+        # every heal round (the shard death's, plus any follow-up
+        # round a racing background placement forces) is in the dump
+        # EXACTLY as many times as the metric counted it
+        heals = [e for e in evs if e["kind"] == "heal"]
+        assert len(heals) == rec["report"]["reshard_events"] >= 1
+        assert heals[0]["mesh_width"] == 1     # shrank to the survivor
+        # ... and every compaction lifecycle event is there exactly once
+        comps = [e for e in evs
+                 if e["kind"] in ("compaction", "major_merge")]
+        assert len(comps) == rec["report"]["compactions_total"]
+        # poison corruptions were recorded by the injector and the
+        # engine edge both
+        assert len([e for e in evs if e["kind"] == "chaos_poison"]) >= 1
+        assert len([e for e in evs if e["kind"] == "poison_reject"]) == 2
+        # parity guardrail unchanged under full observability
+        assert rec["auc_abs_err"] == 0.0

@@ -1,0 +1,294 @@
+"""Unit tests for the observability substrate [ISSUE 6]:
+obs.tracing.Tracer, obs.flight.FlightRecorder,
+obs.metrics_export.MetricsFlusher, obs.report."""
+
+import json
+import threading
+import time
+
+import numpy as np
+
+import pytest
+
+from tuplewise_tpu.obs import (
+    FlightRecorder, MetricsFlusher, Tracer, config_digest,
+    recovery_counters, service_report,
+)
+from tuplewise_tpu.obs.tracing import maybe_span
+from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+
+class TestTracer:
+    def test_nesting_parents_same_thread(self):
+        tr = Tracer()
+        with tr.span("outer") as o:
+            assert tr.current() is o
+            with tr.span("inner") as i:
+                assert i.parent_id == o.span_id
+                assert i.trace_id == o.trace_id
+        spans = tr.spans()
+        assert [s["name"] for s in spans] == ["inner", "outer"]
+        assert spans[1]["parent_id"] is None
+
+    def test_separate_roots_get_separate_traces(self):
+        tr = Tracer()
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+        a, b = tr.spans()
+        assert a["trace_id"] != b["trace_id"]
+
+    def test_explicit_cross_thread_parent(self):
+        tr = Tracer()
+        root = tr.start("request")
+        out = {}
+
+        def worker():
+            with tr.span("apply", parent=root) as sp:
+                out["tid"] = sp.trace_id
+                out["pid"] = sp.parent_id
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        tr.finish(root)
+        assert out["tid"] == root.trace_id
+        assert out["pid"] == root.span_id
+
+    def test_record_span_retroactive(self):
+        tr = Tracer()
+        root = tr.start("r")
+        t0 = time.perf_counter()
+        t1 = t0 + 0.25
+        tr.record_span("wait", t0, t1, parent=root)
+        tr.finish(root)
+        wait = [s for s in tr.spans() if s["name"] == "wait"][0]
+        assert wait["dur_s"] == pytest.approx(0.25)
+        assert wait["parent_id"] == root.span_id
+
+    def test_monotonic_durations_nonnegative(self):
+        tr = Tracer()
+        for _ in range(50):
+            with tr.span("x"):
+                pass
+        assert all(s["dur_s"] >= 0 for s in tr.spans())
+
+    def test_ring_bounds_memory(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr) == 8
+        assert tr.dropped == 12
+        # ring order restored: oldest retained first
+        assert [s["name"] for s in tr.spans()] == [
+            f"s{i}" for i in range(12, 20)]
+
+    def test_disabled_tracer_allocates_nothing(self):
+        tr = Tracer(enabled=False)
+        with tr.span("x") as sp:
+            assert sp is None
+        assert tr.start("y") is None
+        assert len(tr) == 0
+
+    def test_maybe_span_none_is_noop(self):
+        with maybe_span(None, "anything") as sp:
+            assert sp is None
+
+    def test_error_marks_span(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("x")
+        s = tr.spans()[0]
+        assert s["attrs"]["error"] == "ValueError"
+
+    def test_export_jsonl_roundtrip(self, tmp_path):
+        tr = Tracer()
+        with tr.span("a", k=1):
+            with tr.span("b"):
+                pass
+        p = str(tmp_path / "spans.jsonl")
+        assert tr.export_jsonl(p) == 2
+        lines = [json.loads(x) for x in open(p)]
+        assert lines[0]["meta"]["format"] == "tuplewise-spans-v1"
+        names = {r["name"] for r in lines[1:]}
+        assert names == {"a", "b"}
+
+    def test_export_chrome_schema(self, tmp_path):
+        tr = Tracer()
+        with tr.span("a"):
+            pass
+        p = str(tmp_path / "trace.json")
+        tr.export_chrome(p)
+        doc = json.load(open(p))
+        evs = doc["traceEvents"]
+        x = [e for e in evs if e["ph"] == "X"]
+        m = [e for e in evs if e["ph"] == "M"]
+        assert len(x) == 1 and x[0]["name"] == "a"
+        assert x[0]["ts"] >= 0 and x[0]["dur"] >= 0
+        assert any(e["name"] == "thread_name" for e in m)
+        assert any(e["name"] == "process_name" for e in m)
+
+    def test_thread_safety_concurrent_spans(self):
+        tr = Tracer()
+
+        def worker(i):
+            for _ in range(200):
+                with tr.span(f"w{i}"):
+                    with tr.span(f"w{i}.child"):
+                        pass
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        spans = tr.spans()
+        assert len(spans) == 8 * 200 * 2
+        # every child's parent is the matching worker's root, never a
+        # span from another thread
+        by_id = {s["span_id"]: s for s in spans}
+        for s in spans:
+            if s["parent_id"] is not None:
+                parent = by_id[s["parent_id"]]
+                assert s["name"] == parent["name"] + ".child"
+                assert s["trace_id"] == parent["trace_id"]
+
+
+class TestFlightRecorder:
+    def test_record_and_seq(self):
+        fr = FlightRecorder(capacity=16)
+        s1 = fr.record("compaction", tier="minor")
+        s2 = fr.record("heal")
+        assert (s1, s2) == (1, 2)
+        evs = fr.events()
+        assert [e["kind"] for e in evs] == ["compaction", "heal"]
+        assert evs[0]["tier"] == "minor"
+        assert fr.counts() == {"compaction": 1, "heal": 1}
+
+    def test_ring_bounded_keeps_latest(self):
+        fr = FlightRecorder(capacity=4)
+        for i in range(10):
+            fr.record("e", i=i)
+        evs = fr.events()
+        assert len(evs) == 4 and fr.dropped == 6
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]
+        assert [e["seq"] for e in evs] == [7, 8, 9, 10]
+
+    def test_trace_correlation_via_tracer(self):
+        tr = Tracer()
+        fr = FlightRecorder(tracer=tr)
+        with tr.span("op") as sp:
+            fr.record("inside")
+        fr.record("outside")
+        evs = fr.events()
+        assert evs[0]["trace_id"] == sp.trace_id
+        assert evs[1]["trace_id"] is None
+
+    def test_dump_roundtrip(self, tmp_path):
+        fr = FlightRecorder()
+        fr.record("a", x=1)
+        fr.record("b")
+        p = str(tmp_path / "flight.jsonl")
+        assert fr.dump_to(p) == 2
+        d = FlightRecorder.load_dump(p)
+        assert d["format"] == "tuplewise-flight-v1"
+        assert d["n_events"] == 2
+        assert [e["kind"] for e in d["events"]] == ["a", "b"]
+
+    def test_auto_dump_path(self, tmp_path):
+        p = str(tmp_path / "auto.jsonl")
+        fr = FlightRecorder(dump_path=p)
+        fr.record("x")
+        assert fr.auto_dump()
+        assert FlightRecorder.load_dump(p)["n_events"] == 1
+        assert not FlightRecorder().auto_dump()   # no path configured
+
+    def test_auto_dump_never_raises(self, tmp_path):
+        fr = FlightRecorder(dump_path=str(tmp_path / "nodir" / "x" / "y"))
+        fr.record("x")
+        assert fr.auto_dump() is False
+        assert fr.last_dump_error is not None
+
+
+class TestMetricsFlusher:
+    def test_start_stop_writes_at_least_two_rows(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(3)
+        p = str(tmp_path / "m.jsonl")
+        fl = MetricsFlusher(reg, p, every_s=10.0,
+                            meta={"stage": "test"}, config={"a": 1})
+        fl.start()
+        fl.stop()
+        rows = [json.loads(x) for x in open(p)]
+        assert len(rows) >= 2
+        for r in rows:
+            assert r["stage"] == "test"
+            assert r["platform"]
+            assert r["config_digest"] == config_digest({"a": 1})
+            assert r["ts_wall"] > 0 and r["ts_mono"] > 0
+            assert r["metrics"]["c"]["value"] == 3
+        assert rows[-1]["seq"] > rows[0]["seq"]
+
+    def test_periodic_rows(self, tmp_path):
+        reg = MetricsRegistry()
+        p = str(tmp_path / "m.jsonl")
+        with MetricsFlusher(reg, p, every_s=0.05):
+            time.sleep(0.3)
+        rows = [json.loads(x) for x in open(p)]
+        assert len(rows) >= 4   # start + a few ticks + stop
+
+    def test_flush_error_kept_not_raised(self, tmp_path):
+        reg = MetricsRegistry()
+        fl = MetricsFlusher(reg, str(tmp_path), every_s=1.0)  # a dir!
+        fl.flush()
+        assert fl.last_flush_error is not None
+
+    def test_config_digest_stable_and_distinct(self):
+        a = config_digest({"x": 1, "y": 2})
+        assert a == config_digest({"y": 2, "x": 1})
+        assert a != config_digest({"x": 1, "y": 3})
+        from tuplewise_tpu.serving import ServingConfig
+
+        assert config_digest(ServingConfig()) \
+            == config_digest(ServingConfig())
+        assert config_digest(ServingConfig()) \
+            != config_digest(ServingConfig(budget=7))
+
+
+class TestReport:
+    def _metrics(self):
+        reg = MetricsRegistry()
+        reg.counter("poison_rejects").inc(2)
+        reg.counter("reshard_events").inc(1)
+        reg.histogram("insert_latency_s").observe(0.01)
+        from tuplewise_tpu.obs.report import INSERT_STAGES, stage_metric
+
+        # stages that tile the 10ms total
+        per = 0.01 / len(INSERT_STAGES)
+        for s in INSERT_STAGES:
+            reg.histogram(stage_metric(s)).observe(per)
+        return reg.snapshot()
+
+    def test_recovery_counters_keys(self):
+        rc = recovery_counters(self._metrics())
+        assert rc["poison_rejects"] == 2
+        assert rc["reshard_events"] == 1
+        assert rc["major_merge_fallbacks"] == 0
+        assert "shard_retries_total" in rc
+
+    def test_service_report_carries_stages_and_counters(self):
+        rep = service_report(self._metrics())
+        assert set(recovery_counters(self._metrics())) <= set(rep)
+        assert rep["poison_rejects"] == 2
+        assert len(rep["insert_stage_p99_ms"]) == 7
+        attr = rep["stage_attribution"]
+        assert attr["coverage"] == pytest.approx(1.0)
+
+    def test_stage_attribution_none_without_inserts(self):
+        rep = service_report(MetricsRegistry().snapshot())
+        assert rep["stage_attribution"] is None
+        assert rep["insert_stage_p99_ms"] == {}

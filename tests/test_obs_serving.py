@@ -1,0 +1,246 @@
+"""Serving-path observability integration tests [ISSUE 6]: span
+integrity under concurrent batcher/compactor/healer activity, stage
+attribution, Chrome-trace schema, serve/replay report parity, flight
+persistence next to snapshots, and the tracing-disabled guard."""
+
+import io
+import json
+import sys
+import threading
+
+import numpy as np
+
+import pytest
+
+from tuplewise_tpu.obs import FlightRecorder, Tracer
+from tuplewise_tpu.serving import MicroBatchEngine, ServingConfig
+from tuplewise_tpu.serving.replay import make_stream, replay
+
+
+def _stream(n, seed=0):
+    return make_stream(n, pos_frac=0.5, separation=1.0, seed=seed)
+
+
+class TestTracedServing:
+    def test_span_integrity_under_concurrency(self):
+        """Batcher + background compactor + multiple submitter threads
+        all record concurrently; every parent id must resolve inside
+        the same trace and insert stage spans must tile their root."""
+        scores, labels = _stream(3000)
+        tracer = Tracer(capacity=1 << 16)
+        cfg = ServingConfig(policy="block", compact_every=128,
+                            bg_compact=True, flush_timeout_s=0.001)
+        with MicroBatchEngine(cfg, tracer=tracer) as eng:
+            def submit(lo, hi):
+                for i in range(lo, hi):
+                    eng.insert(scores[i], labels[i]).result(30.0)
+
+            threads = [threading.Thread(target=submit,
+                                        args=(i * 750, (i + 1) * 750))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            eng.index.wait_idle()
+        spans = tracer.spans()
+        assert tracer.dropped == 0
+        by_id = {s["span_id"]: s for s in spans}
+        roots = {}
+        for s in spans:
+            if s["parent_id"] is None:
+                roots.setdefault(s["trace_id"], []).append(s)
+            else:
+                parent = by_id[s["parent_id"]]      # must resolve
+                assert parent["trace_id"] == s["trace_id"]
+        # one root per trace — a child never leaks into another trace
+        assert all(len(r) == 1 for r in roots.values())
+        # compactor activity traced on its own thread, its own traces
+        compactor = [s for s in spans
+                     if s["thread"] == "tuplewise-compactor"]
+        assert any(s["name"] == "compactor.build" for s in compactor)
+        insert_threads = {s["thread"] for s in spans
+                          if s["name"] == "request.insert"}
+        assert len(insert_threads) >= 2     # concurrent submitters
+
+    def test_stage_spans_tile_each_insert(self):
+        scores, labels = _stream(1200)
+        tracer = Tracer()
+        rec = replay(scores, labels,
+                     config=ServingConfig(policy="block",
+                                          compact_every=256),
+                     max_inflight=64, tracer=tracer)
+        spans = tracer.spans()
+        child_sum = {}
+        for s in spans:
+            if s["parent_id"] is not None:
+                child_sum[s["parent_id"]] = \
+                    child_sum.get(s["parent_id"], 0.0) + s["dur_s"]
+        roots = [s for s in spans if s["name"] == "request.insert"]
+        assert len(roots) == 1200
+        for r in roots:
+            if r["dur_s"] > 0:
+                assert child_sum.get(r["span_id"], 0.0) \
+                    >= 0.95 * r["dur_s"]
+        # ... and the histogram-side attribution agrees exactly
+        assert rec["stage_attribution"]["coverage"] \
+            == pytest.approx(1.0, abs=1e-6)
+
+    def test_chrome_export_schema(self, tmp_path):
+        scores, labels = _stream(400)
+        out = str(tmp_path / "trace.json")
+        rec = replay(scores, labels,
+                     config=ServingConfig(policy="block"),
+                     max_inflight=64, trace_out=out)
+        assert rec["trace_out"] == out and rec["trace_spans"] > 0
+        doc = json.load(open(out))
+        assert isinstance(doc["traceEvents"], list)
+        x = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert x, "no complete events"
+        for e in x:
+            assert {"name", "pid", "tid", "ts", "dur"} <= set(e)
+            assert e["dur"] >= 0
+            assert "trace_id" in e["args"] and "span_id" in e["args"]
+        # thread metadata present for every tid used
+        tids = {e["tid"] for e in x}
+        named = {e["tid"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert tids <= named
+
+    def test_disabled_tracing_is_default_and_structural_noop(self):
+        scores, labels = _stream(300)
+        cfg = ServingConfig(policy="block", compact_every=128)
+        with MicroBatchEngine(cfg) as eng:
+            assert eng.tracer is None
+            assert eng.index.tracer is None
+            fut = eng.insert(scores, labels)
+            assert fut.result(30.0) == 300
+            eng.flush()
+            stats = eng.stats()
+        # stage histograms still attribute latency with tracing off
+        m = stats["metrics"]
+        assert m["insert_stage_queue_wait_s"]["count"] == 1
+        total = m["insert_latency_s"]["sum"]
+        attributed = sum(
+            m[f"insert_stage_{s}_s"]["sum"]
+            for s in ("queue_wait", "coalesce", "wal_append",
+                      "index_insert", "stream_extend", "snapshot",
+                      "resolve"))
+        assert attributed == pytest.approx(total, rel=1e-9)
+
+    @pytest.mark.slow
+    def test_trace_disabled_overhead_close_to_traced_off_baseline(self):
+        """Coarse overhead guard (the authoritative one is bench.py
+        --streaming vs the PR 5 baseline): tracing OFF must not be
+        slower than tracing ON — and the two runs bound the plumbing
+        cost of this PR's always-on stage attribution."""
+        scores, labels = _stream(20_000, seed=3)
+        cfg = ServingConfig(policy="block", compact_every=1024,
+                            bg_compact=True, flush_timeout_s=0.0005)
+        base = replay(scores, labels, config=cfg, warmup=True,
+                      max_inflight=64)
+        traced = replay(scores, labels, config=cfg, warmup=True,
+                        max_inflight=64, tracer=Tracer(capacity=1 << 18))
+        assert base["insert_latency_p99_ms"] \
+            <= 1.5 * traced["insert_latency_p99_ms"]
+
+
+class TestReportParity:
+    def test_serve_exit_summary_matches_replay_report(self, monkeypatch,
+                                                      capsys):
+        """ONE report builder feeds both surfaces: the serve exit
+        summary and the replay record must carry the same keys and,
+        for a deterministic stream, the same counter values."""
+        from tuplewise_tpu.harness.cli import _serve_stdin
+
+        scores, labels = _stream(600, seed=1)
+        cfg = ServingConfig(policy="block", compact_every=128,
+                            bg_compact=False)
+        rec = replay(scores, labels, config=cfg, max_inflight=32)
+        lines = "".join(
+            json.dumps({"op": "insert", "score": float(s),
+                        "label": int(l)}) + "\n"
+            for s, l in zip(scores, labels))
+        monkeypatch.setattr(sys, "stdin", io.StringIO(lines))
+        assert _serve_stdin(cfg) == 0
+        err = capsys.readouterr().err
+        summary = None
+        for line in err.splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "exit_summary" in row:
+                summary = row["exit_summary"]
+        assert summary is not None
+        rep = rec["report"]
+        # serve additionally reports flight-event counts; everything
+        # else is the SAME builder output
+        assert set(rep) | {"flight_events"} == set(summary)
+        for k in ("compactions_total", "rejected_total",
+                  "poison_rejects", "deadline_expired_total",
+                  "reshard_events", "batcher_restarts",
+                  "major_merge_fallbacks", "bytes_h2d"):
+            assert summary[k] == rep[k], k
+
+    def test_replay_faults_block_uses_unified_counters(self):
+        scores, labels = _stream(800, seed=2)
+        chaos = {"faults": [
+            {"point": "poison", "at_events": [10, 20], "value": "inf"}]}
+        rec = replay(scores, labels,
+                     config=ServingConfig(policy="block",
+                                          compact_every=256),
+                     max_inflight=32, chaos=chaos)
+        from tuplewise_tpu.obs.report import recovery_counters
+
+        expected = set(recovery_counters({})) | {"chaos"}
+        assert set(rec["faults"]) == expected
+        assert rec["faults"]["poison_rejects"] == 2
+        assert rec["report"]["poison_rejects"] == 2
+
+
+class TestFlightInServing:
+    def test_flight_dump_lands_next_to_snapshots(self, tmp_path):
+        snapdir = str(tmp_path / "snap")
+        scores, labels = _stream(900, seed=4)
+        cfg = ServingConfig(policy="block", compact_every=128,
+                            snapshot_dir=snapdir, snapshot_every=256)
+        with MicroBatchEngine(cfg) as eng:
+            for i in range(0, 900, 45):
+                eng.insert(scores[i:i + 45], labels[i:i + 45])
+            eng.flush()
+        dump = FlightRecorder.load_dump(
+            str(tmp_path / "snap" / "flight.jsonl"))
+        kinds = {e["kind"] for e in dump["events"]}
+        assert "wal_seal" in kinds
+        assert "snapshot_landed" in kinds
+        assert "engine_closed" in kinds
+        seqs = [e["seq"] for e in dump["events"]]
+        assert seqs == sorted(seqs)
+
+    def test_lifecycle_events_recorded(self):
+        scores, labels = _stream(600, seed=5)
+        cfg = ServingConfig(policy="block", compact_every=128)
+        with MicroBatchEngine(cfg) as eng:
+            eng.insert(scores, labels).result(30.0)
+            with pytest.raises(Exception):
+                eng.insert([float("nan")], [1]).result(30.0)
+            eng.flush()
+            counts = eng.flight.counts()
+        assert counts.get("poison_reject") == 1
+        assert counts.get("compaction", 0) >= 1
+
+    def test_metrics_flusher_through_replay(self, tmp_path):
+        p = str(tmp_path / "metrics.jsonl")
+        scores, labels = _stream(500, seed=6)
+        rec = replay(scores, labels,
+                     config=ServingConfig(policy="block"),
+                     max_inflight=64, metrics_out=p,
+                     metrics_every_s=0.05)
+        assert rec["metrics_out"] == p
+        rows = [json.loads(x) for x in open(p)]
+        assert len(rows) >= 2
+        assert rows[-1]["metrics"]["events_total"]["value"] == 500
+        # live gauges are present in the stream
+        assert "queue_depth_live" in rows[-1]["metrics"]
+        assert "mesh_width" in rows[-1]["metrics"]

@@ -8,10 +8,12 @@ import pytest
 
 from tuplewise_tpu.utils.profiling import (
     Counter,
+    Gauge,
     Histogram,
     MetricsRegistry,
     annotate,
     device_memory_stats,
+    labeled_name,
     timer,
     trace,
 )
@@ -138,11 +140,100 @@ class TestHistogram:
         assert snap["buckets"]["+inf"] == 1     # 50.0
 
 
+class TestGauge:
+    def test_set_add_value(self):
+        g = Gauge("queue_depth")
+        g.set(5)
+        g.add(3)
+        g.add(-6)
+        assert g.value == 2.0
+        assert g.snapshot() == {"type": "gauge", "value": 2.0}
+
+    def test_gauge_goes_negative(self):
+        g = Gauge("drift")
+        g.add(-4)
+        assert g.value == -4.0
+
+    def test_thread_safety(self):
+        import threading
+
+        g = Gauge("n")
+        threads = [
+            threading.Thread(target=lambda: [g.add(1) for _ in range(500)])
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert g.value == 4000.0
+
+
+class TestLabels:
+    def test_labeled_name_canonical(self):
+        assert labeled_name("m", None) == "m"
+        assert labeled_name("m", {"b": 1, "a": "x"}) == "m{a=x,b=1}"
+
+    def test_labels_in_snapshots(self):
+        c = Counter("reqs", labels={"tenant": "t1"})
+        c.inc()
+        assert c.snapshot()["labels"] == {"tenant": "t1"}
+        g = Gauge("depth", labels={"shard": 2})
+        assert g.snapshot()["labels"] == {"shard": 2}
+        h = Histogram("lat", labels={"stage": "wal"})
+        h.observe(0.1)
+        assert h.snapshot()["labels"] == {"stage": "wal"}
+
+    def test_registry_keeps_label_series_distinct(self):
+        r = MetricsRegistry()
+        a = r.counter("reqs", labels={"tenant": "a"})
+        b = r.counter("reqs", labels={"tenant": "b"})
+        assert a is not b
+        a.inc(2)
+        b.inc(5)
+        snap = r.snapshot()
+        assert snap["reqs{tenant=a}"]["value"] == 2
+        assert snap["reqs{tenant=b}"]["value"] == 5
+        # create-or-return works per label set, for every metric type
+        assert r.counter("reqs", labels={"tenant": "a"}) is a
+        g = r.gauge("w", labels={"shard": 1})
+        assert r.gauge("w", labels={"shard": 1}) is g
+        h = r.histogram("h", labels={"s": 1})
+        assert r.histogram("h", labels={"s": 1}) is h
+
+
+class TestObserveN:
+    def test_observe_n_matches_n_observes(self):
+        a = Histogram("a")
+        b = Histogram("b")
+        a.observe_n(0.02, 7)
+        for _ in range(7):
+            b.observe(0.02)
+        sa, sb = a.snapshot(), b.snapshot()
+        for k in ("count", "sum", "min", "max", "p50", "p99"):
+            assert sa[k] == sb[k], k
+        assert sa["buckets"] == sb["buckets"]
+
+    def test_observe_n_zero_is_noop_negative_raises(self):
+        h = Histogram("h")
+        h.observe_n(1.0, 0)
+        assert h.count == 0
+        with pytest.raises(ValueError, match="negative"):
+            h.observe_n(1.0, -1)
+
+    def test_observe_n_bounded_by_sample_window(self):
+        h = Histogram("h", max_samples=8)
+        h.observe_n(1.0, 1000)
+        assert h.count == 1000
+        assert len(h._samples) == 8
+
+
 class TestMetricsRegistry:
     def test_create_or_return(self):
         r = MetricsRegistry()
         assert r.counter("a") is r.counter("a")
         assert r.histogram("h") is r.histogram("h")
+        assert r.gauge("g") is r.gauge("g")
 
     def test_type_conflict_raises(self):
         r = MetricsRegistry()

@@ -68,6 +68,51 @@ def _chaos_from(args):
     return FaultInjector.from_spec(spec)
 
 
+def _add_batch_obs_flags(p: argparse.ArgumentParser) -> None:
+    """Observability flags for the batch-path subcommands [ISSUE 6]:
+    span tracing of chunks/checkpoints and live metric snapshots."""
+    p.add_argument("--trace-out", type=str, default=None,
+                   help="export the span trace (train.chunk / "
+                        "train.checkpoint / heal spans) here: *.jsonl "
+                        "= span JSONL, else Chrome trace JSON")
+    p.add_argument("--metrics-out", type=str, default=None,
+                   help="append periodic registry snapshots (live "
+                        "train_step/train_loss_last gauges + recovery "
+                        "counters) as JSONL here while training")
+    p.add_argument("--metrics-every", type=float, default=1.0,
+                   help="seconds between --metrics-out snapshots")
+
+
+def _batch_obs_from(args):
+    """(tracer, registry, flusher) for a batch subcommand — all None
+    when the flags are absent. Caller stops the flusher and exports
+    the tracer via ``_finish_batch_obs``."""
+    tracer = registry = flusher = None
+    if getattr(args, "trace_out", None):
+        from tuplewise_tpu.obs.tracing import Tracer
+
+        tracer = Tracer()
+    if getattr(args, "metrics_out", None):
+        from tuplewise_tpu.obs import MetricsFlusher
+        from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+        registry = MetricsRegistry()
+        flusher = MetricsFlusher(
+            registry, args.metrics_out, every_s=args.metrics_every,
+            meta={"stage": args.cmd}).start()
+    return tracer, registry, flusher
+
+
+def _finish_batch_obs(args, tracer, flusher) -> None:
+    if flusher is not None:
+        flusher.stop()
+    if tracer is not None:
+        if args.trace_out.endswith(".jsonl"):
+            tracer.export_jsonl(args.trace_out)
+        else:
+            tracer.export_chrome(args.trace_out)
+
+
 def _add_budget_flags(p: argparse.ArgumentParser) -> None:
     """The per-step budget/recording flags shared by the learning and
     train subcommands — one definition, no drift."""
@@ -107,85 +152,89 @@ def _emit(results, out):
         write_jsonl(results, out)
 
 
-def _serve_stdin(cfg, chaos=None) -> int:
+def _serve_stdin(cfg, chaos=None, obs=None) -> int:
     """The ``serve`` loop: one JSONL request per stdin line, one JSONL
-    response per stdout line (same order); final stats to stderr."""
+    response per stdout line (same order); final stats to stderr.
+
+    ``obs`` [ISSUE 6]: the observability argparse namespace — span
+    tracing (``--trace-out``), live metrics export (``--metrics-out`` /
+    ``--metrics-every``), jax profiling (``--profile-dir``), and the
+    flight-recorder dump path (``--flight-out``; with ``--snapshot-dir``
+    the engine also auto-dumps next to the snapshots).
+    """
+    from tuplewise_tpu.obs import MetricsFlusher, service_report
+    from tuplewise_tpu.obs.tracing import Tracer
     from tuplewise_tpu.serving import (
         BackpressureError, DeadlineExceededError, EngineClosedError,
         MicroBatchEngine, PoisonEventError,
     )
+    from tuplewise_tpu.utils.profiling import trace as _jax_trace
 
-    with MicroBatchEngine(cfg, chaos=chaos) as eng:
-        for line in sys.stdin:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                req = json.loads(line)
-                op = req["op"]
-                if op == "insert":
-                    fut = eng.insert(req["score"], req["label"])
-                    resp = {"ok": True, "inserted": int(fut.result(30.0))}
-                elif op == "score":
-                    fut = eng.score(req["score"])
-                    ranks = fut.result(30.0)
-                    resp = {"ok": True,
-                            "rank": [None if np.isnan(r) else float(r)
-                                     for r in np.atleast_1d(ranks)]}
-                elif op == "query":
-                    snap = eng.query().result(30.0)
-                    resp = {"ok": True,
-                            "auc_exact": snap.get("auc_exact"),
-                            "estimate_incomplete":
-                                snap["estimate_incomplete"],
-                            "state": snap.get("index")}
-                else:
-                    resp = {"ok": False, "error": f"unknown op {op!r}"}
-            except PoisonEventError as e:
-                resp = {"ok": False, "error": f"poison: {e}"}
-            except BackpressureError as e:
-                resp = {"ok": False, "error": f"backpressure: {e}"}
-            except DeadlineExceededError as e:
-                resp = {"ok": False, "error": f"deadline: {e}"}
-            except EngineClosedError as e:
-                resp = {"ok": False, "error": f"closed: {e}"}
-            except (KeyError, ValueError, json.JSONDecodeError) as e:
-                resp = {"ok": False, "error": f"bad request: {e}"}
-            print(json.dumps(resp), flush=True)
+    tracer = Tracer() if obs is not None and obs.trace_out else None
+    flusher = None
+    with MicroBatchEngine(cfg, chaos=chaos, tracer=tracer) as eng:
+        if obs is not None and obs.metrics_out:
+            flusher = MetricsFlusher(
+                eng.metrics, obs.metrics_out,
+                every_s=obs.metrics_every,
+                meta={"stage": "serve"}, config=cfg).start()
+        with _jax_trace(obs.profile_dir if obs is not None else None):
+            for line in sys.stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                    op = req["op"]
+                    if op == "insert":
+                        fut = eng.insert(req["score"], req["label"])
+                        resp = {"ok": True,
+                                "inserted": int(fut.result(30.0))}
+                    elif op == "score":
+                        fut = eng.score(req["score"])
+                        ranks = fut.result(30.0)
+                        resp = {"ok": True,
+                                "rank": [None if np.isnan(r) else float(r)
+                                         for r in np.atleast_1d(ranks)]}
+                    elif op == "query":
+                        snap = eng.query().result(30.0)
+                        resp = {"ok": True,
+                                "auc_exact": snap.get("auc_exact"),
+                                "estimate_incomplete":
+                                    snap["estimate_incomplete"],
+                                "state": snap.get("index")}
+                    else:
+                        resp = {"ok": False, "error": f"unknown op {op!r}"}
+                except PoisonEventError as e:
+                    resp = {"ok": False, "error": f"poison: {e}"}
+                except BackpressureError as e:
+                    resp = {"ok": False, "error": f"backpressure: {e}"}
+                except DeadlineExceededError as e:
+                    resp = {"ok": False, "error": f"deadline: {e}"}
+                except EngineClosedError as e:
+                    resp = {"ok": False, "error": f"closed: {e}"}
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    resp = {"ok": False, "error": f"bad request: {e}"}
+                print(json.dumps(resp), flush=True)
+        if flusher is not None:
+            flusher.stop()
         stats = eng.stats()
+        flight = eng.flight
+    # dump AFTER close so the file carries engine_closed + the final
+    # snapshot's lifecycle events
+    if obs is not None and obs.flight_out:
+        flight.dump_to(obs.flight_out)
     m = stats["metrics"]
-
-    def _v(name):
-        return m.get(name, {}).get("value", 0)
-
-    def _p(name, q):
-        v = m.get(name, {}).get(q)
-        return None if v is None else round(v * 1e3, 3)
+    if tracer is not None:
+        if obs.trace_out.endswith(".jsonl"):
+            tracer.export_jsonl(obs.trace_out)
+        else:
+            tracer.export_chrome(obs.trace_out)
 
     # exit summary: the load-shedding, pause, and recovery numbers an
-    # operator greps for first, ahead of the full metrics dump
-    summary = {
-        "rejected_total": _v("rejected_total"),
-        "dropped_total": _v("dropped_total"),
-        "compactions_total": _v("compactions_total"),
-        "compaction_pause_p99_ms": _p("compaction_pause_s", "p99"),
-        "compaction_pause_max_ms": _p("compaction_pause_s", "max"),
-        "insert_latency_p99_ms": _p("insert_latency_s", "p99"),
-        # transfer accounting [ISSUE 5]: the shuffle-bytes budget of
-        # the compaction tiers, and the on-mesh merge counters
-        "bytes_h2d": _v("bytes_h2d"),
-        "bytes_h2d_saved": _v("bytes_h2d_saved"),
-        "major_merges_total": _v("major_merges_total"),
-        "major_merge_fallbacks": _v("major_merge_fallbacks"),
-        # fault-tolerance counters [ISSUE 3]
-        "reshard_events": _v("reshard_events"),
-        "bg_compactor_restarts": _v("bg_compactor_restarts"),
-        "batcher_restarts": _v("batcher_restarts"),
-        "poison_rejects": _v("poison_rejects"),
-        "deadline_expired_total": _v("deadline_expired_total"),
-    }
-    if chaos is not None:
-        summary["chaos"] = chaos.snapshot()
+    # operator greps for first, ahead of the full metrics dump — built
+    # by the SAME report builder replay records use [ISSUE 6 satellite]
+    summary = service_report(m, chaos=chaos, flight=flight)
     print(json.dumps({"exit_summary": summary}), file=sys.stderr)
     print(json.dumps({"final_stats": m}), file=sys.stderr)
     return 0
@@ -259,6 +308,7 @@ def main(argv=None) -> int:
     p.add_argument("--n", type=int, default=8000)
     p.add_argument("--out", type=str, default=None)
     _add_robustness_flags(p)
+    _add_batch_obs_flags(p)
 
     p = sub.add_parser(
         "train-triplet",
@@ -278,6 +328,7 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", type=str, default=None)
     _add_robustness_flags(p)
+    _add_batch_obs_flags(p)
 
     def _add_serving_flags(p: argparse.ArgumentParser) -> None:
         """ServingConfig knobs shared by serve and replay."""
@@ -340,6 +391,28 @@ def main(argv=None) -> int:
                             "can drop the tail), 'batch' fsyncs every "
                             "append (closes the power-loss window at "
                             "per-batch latency cost — DESIGN §9)")
+        # observability [ISSUE 6]
+        p.add_argument("--trace-out", type=str, default=None,
+                       help="export the span trace here: *.jsonl = "
+                            "span JSONL (scripts/trace_summary.py), "
+                            "anything else = Chrome trace-event JSON "
+                            "(perfetto / chrome://tracing)")
+        p.add_argument("--metrics-out", type=str, default=None,
+                       help="append periodic whole-registry metric "
+                            "snapshots (JSONL) here while serving, "
+                            "e.g. results/metrics.jsonl")
+        p.add_argument("--metrics-every", type=float, default=1.0,
+                       help="seconds between --metrics-out snapshots")
+        p.add_argument("--profile-dir", type=str, default=None,
+                       help="bracket the run in a jax.profiler trace "
+                            "written here (TensorBoard/perfetto)")
+        p.add_argument("--flight-recorder-size", type=int, default=4096,
+                       help="lifecycle-event ring capacity (the dump "
+                            "lands next to --snapshot-dir snapshots "
+                            "and/or at --flight-out)")
+        p.add_argument("--flight-out", type=str, default=None,
+                       help="dump the flight recorder (JSONL) here on "
+                            "exit")
         p.add_argument("--seed", type=int, default=0)
 
     p = sub.add_parser(
@@ -386,6 +459,7 @@ def main(argv=None) -> int:
             snapshot_dir=args.snapshot_dir,
             snapshot_every=args.snapshot_every, recover=args.recover,
             wal_fsync=args.wal_fsync,
+            flight_recorder_size=args.flight_recorder_size,
             seed=args.seed,
         )
         chaos = None
@@ -402,11 +476,16 @@ def main(argv=None) -> int:
             _emit(
                 replay(scores, labels, config=cfg, chunk=args.chunk,
                        score_every=args.score_every,
-                       query_every=args.query_every, chaos=chaos),
+                       query_every=args.query_every, chaos=chaos,
+                       trace_out=args.trace_out,
+                       metrics_out=args.metrics_out,
+                       metrics_every_s=args.metrics_every,
+                       profile_dir=args.profile_dir,
+                       flight_out=args.flight_out),
                 args.out,
             )
             return 0
-        return _serve_stdin(cfg, chaos=chaos)
+        return _serve_stdin(cfg, chaos=chaos, obs=args)
 
     if args.cmd == "variance":
         from tuplewise_tpu.utils.checkpoint import prepare_resume
@@ -527,12 +606,15 @@ def main(argv=None) -> int:
         )
 
         prepare_resume(args.checkpoint, args.resume)
+        tracer, registry, flusher = _batch_obs_from(args)
         params, hist = train_pairwise(
             scorer, p0, Xp, Xn, cfg,
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
             chaos=_chaos_from(args),
+            tracer=tracer, metrics=registry,
         )
+        _finish_batch_obs(args, tracer, flusher)
         _emit(
             {
                 "config": dataclasses.asdict(cfg),
@@ -576,12 +658,15 @@ def main(argv=None) -> int:
             seed=args.seed,
         )
         prepare_resume(args.checkpoint, args.resume)
+        tracer, registry, flusher = _batch_obs_from(args)
         params, hist = train_triplet(
             init_embed(args.dim, args.embed_dim, args.seed), Xc, Xo,
             cfg, checkpoint_path=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
             chaos=_chaos_from(args),
+            tracer=tracer, metrics=registry,
         )
+        _finish_batch_obs(args, tracer, flusher)
         _emit(
             {
                 "config": dataclasses.asdict(cfg),

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -230,6 +231,8 @@ def train_pairwise(
     chaos=None,
     heal_retries: int = 2,
     retry_backoff_s: float = 0.05,
+    tracer=None,
+    metrics=None,
 ):
     """Distributed pairwise SGD over a device mesh.
 
@@ -264,6 +267,15 @@ def train_pairwise(
     ``testing.chaos.FaultInjector`` fired at the ``train_step`` hook
     (before each chunk) and ``checkpoint`` hook (after each save —
     where the ``sigkill`` action models real preemption).
+
+    ``tracer`` [ISSUE 6]: an ``obs.tracing.Tracer`` — each scan chunk
+    becomes a ``train.chunk`` span and each checkpoint save a
+    ``train.checkpoint`` span (one trace per training run), so a slow
+    run's timeline shows where the wall-clock went. ``metrics``: a
+    ``MetricsRegistry`` that receives live gauges (``train_step``,
+    ``train_loss_last``), a ``train_chunk_s`` histogram, and the
+    healer's recovery counters — what ``tuplewise train
+    --metrics-out`` streams through the ``obs.MetricsFlusher``.
     """
     kernel = get_kernel(cfg.kernel)
     if kernel.kind != "diff":
@@ -348,7 +360,16 @@ def train_pairwise(
     if heal_retries:
         healer = MeshHealer(
             mesh, fixed_width=N, pool=list(jax.devices()), chaos=chaos,
-            backoff=Backoff(base_s=retry_backoff_s, seed=cfg.seed))
+            backoff=Backoff(base_s=retry_backoff_s, seed=cfg.seed),
+            metrics=metrics, tracer=tracer)
+
+    # live training gauges [ISSUE 6]: what --metrics-out streams
+    g_step = g_loss = h_chunk = None
+    if metrics is not None:
+        g_step = metrics.gauge("train_step")
+        g_loss = metrics.gauge("train_loss_last")
+        h_chunk = metrics.histogram("train_chunk_s")
+        metrics.gauge("mesh_width").set(N)
 
     def on_heal(h):
         # adopt the healed mesh and re-place EVERYTHING on it: data
@@ -364,6 +385,12 @@ def train_pairwise(
         run_chunk = _compiled_trainer(
             scorer, dataclasses.replace(cfg, steps=0), mesh, n1, n2)
 
+    from tuplewise_tpu.obs.tracing import maybe_span
+
+    run_span = None
+    if tracer is not None:
+        run_span = tracer.start("train.run", parent=None,
+                                steps=cfg.steps, n_workers=N)
     for t, chunk in iter_chunks(start, cfg.steps, checkpoint_every):
         def attempt(t=t, chunk=chunk):
             if chaos is not None:
@@ -371,25 +398,39 @@ def train_pairwise(
             return run_chunk(params, Xp, Xn, jnp.asarray(t, jnp.int32),
                              chunk)
 
-        if healer is not None:
-            params, losses = healer.run(attempt, retries=heal_retries,
-                                        on_heal=on_heal)
-        else:
-            params, losses = attempt()
+        t_chunk0 = time.perf_counter()
+        with maybe_span(tracer, "train.chunk", parent=run_span,
+                        step=t, steps=chunk):
+            if healer is not None:
+                params, losses = healer.run(attempt,
+                                            retries=heal_retries,
+                                            on_heal=on_heal)
+            else:
+                params, losses = attempt()
         loss_parts.append(np.asarray(losses))
+        if metrics is not None:
+            h_chunk.observe(time.perf_counter() - t_chunk0)
+            g_step.set(t + chunk)
+            last = float(np.asarray(losses)[-1]) if len(losses) else None
+            if last is not None and np.isfinite(last):
+                g_loss.set(last)
         if checkpoint_path:
-            save_checkpoint(
-                checkpoint_path,
-                step=t + chunk,
-                params=jax.tree.map(np.asarray, params),
-                extra={"loss": np.concatenate(loss_parts)},
-                config=dataclasses.asdict(cfg),
-            )
+            with maybe_span(tracer, "train.checkpoint",
+                            parent=run_span, step=t + chunk):
+                save_checkpoint(
+                    checkpoint_path,
+                    step=t + chunk,
+                    params=jax.tree.map(np.asarray, params),
+                    extra={"loss": np.concatenate(loss_parts)},
+                    config=dataclasses.asdict(cfg),
+                )
             if chaos is not None:
                 # deterministic preemption point: the checkpoint above
                 # is durable, so a 'sigkill' scheduled here dies with
                 # exactly t + chunk steps recoverable
                 chaos.fire("checkpoint")
+    if tracer is not None:
+        tracer.finish(run_span)
     history = {"loss": np.concatenate(loss_parts)}
     if healer is not None:
         history["recovery"] = {

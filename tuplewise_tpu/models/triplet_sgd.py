@@ -194,6 +194,8 @@ def train_triplet(
     chaos=None,
     heal_retries: int = 2,
     retry_backoff_s: float = 0.05,
+    tracer=None,
+    metrics=None,
 ):
     """Distributed triplet SGD: anchors/positives from X_class (the
     target class), negatives from X_other. Returns (params, history);
@@ -222,7 +224,9 @@ def train_triplet(
     ``parallel.self_heal.MeshHealer`` — probe, rebuild the mesh at the
     SAME logical width from the spare-device pool, re-place data and
     params, retry with bounded jittered backoff. ``chaos`` fires at the
-    ``train_step`` / ``checkpoint`` hook points."""
+    ``train_step`` / ``checkpoint`` hook points. ``tracer`` [ISSUE 6]:
+    scan chunks and checkpoint saves become ``train.chunk`` /
+    ``train.checkpoint`` spans, same taxonomy as ``train_pairwise``."""
     kernel = get_kernel(cfg.kernel)
     if kernel.kind != "triplet":
         raise ValueError(
@@ -298,18 +302,21 @@ def train_triplet(
                 nxt = min(nxt, t - t % e + e)
         return nxt
 
+    from tuplewise_tpu.obs.tracing import maybe_span
+
     def save(step):
-        save_checkpoint(
-            checkpoint_path,
-            step=step,
-            params=jax.tree.map(np.asarray, params),
-            extra={
-                "loss": np.concatenate(loss_parts),
-                "curve_steps": np.asarray(curve_steps),
-                "curve_acc": np.asarray(curve_acc),
-            },
-            config=ck_config,
-        )
+        with maybe_span(tracer, "train.checkpoint", step=step):
+            save_checkpoint(
+                checkpoint_path,
+                step=step,
+                params=jax.tree.map(np.asarray, params),
+                extra={
+                    "loss": np.concatenate(loss_parts),
+                    "curve_steps": np.asarray(curve_steps),
+                    "curve_acc": np.asarray(curve_acc),
+                },
+                config=ck_config,
+            )
         if chaos is not None:
             # durable-state preemption point ('sigkill' dies here)
             chaos.fire("checkpoint")
@@ -321,7 +328,12 @@ def train_triplet(
     if heal_retries:
         healer = MeshHealer(
             mesh, fixed_width=N, pool=list(jax.devices()), chaos=chaos,
-            backoff=Backoff(base_s=retry_backoff_s, seed=cfg.seed))
+            backoff=Backoff(base_s=retry_backoff_s, seed=cfg.seed),
+            metrics=metrics, tracer=tracer)
+    g_step = None
+    if metrics is not None:
+        g_step = metrics.gauge("train_step")
+        metrics.gauge("mesh_width").set(N)
 
     def on_heal(h):
         nonlocal mesh, replicated, Xc, Xo, params, run_chunk
@@ -343,12 +355,16 @@ def train_triplet(
             return run_chunk(params, Xc, Xo, jnp.asarray(t0, jnp.int32),
                              t1 - t0)
 
-        if healer is not None:
-            params, losses = healer.run(attempt, retries=heal_retries,
-                                        on_heal=on_heal)
-        else:
-            params, losses = attempt()
+        with maybe_span(tracer, "train.chunk", step=t0, steps=t1 - t0):
+            if healer is not None:
+                params, losses = healer.run(attempt,
+                                            retries=heal_retries,
+                                            on_heal=on_heal)
+            else:
+                params, losses = attempt()
         loss_parts.append(np.asarray(losses))
+        if g_step is not None:
+            g_step.set(t1)
         if eval_every is not None and (
             t1 % eval_every == 0 or t1 == cfg.steps
         ):

@@ -1,0 +1,50 @@
+"""End-to-end observability substrate [ISSUE 6].
+
+PRs 1-5 built a serving and batch stack that can only be understood
+post-hoc, from exit summaries and scattered JSONL rows. This package is
+the instrumentation layer every subsequent ROADMAP item (multi-tenant
+SLOs, network front-end latency frontiers, variance-adaptive budgets)
+builds on:
+
+* ``tracing.Tracer``        — low-overhead span tracing: monotonic
+                              clocks, explicit parent/child span ids,
+                              thread-safe ring storage, hard-off by
+                              default (call sites hold ``None`` and pay
+                              one ``is not None`` check). Exports JSONL
+                              and Chrome trace-event JSON so perfetto /
+                              ``chrome://tracing`` render the serving
+                              timeline directly.
+* ``flight.FlightRecorder`` — a bounded structured ring of lifecycle
+                              events (compactions, major merges, heals,
+                              restarts, chaos injections, snapshot/WAL
+                              seals, poison rejects, deadline expiries)
+                              with sequence numbers and trace-id
+                              correlation; dumped automatically on
+                              crash / heal exhaustion / close and
+                              persisted alongside recovery snapshots.
+* ``metrics_export.MetricsFlusher`` — a side thread appending
+                              whole-registry snapshots (wall/monotonic
+                              timestamps, platform, config digest) to a
+                              JSONL path at a fixed cadence — the live
+                              view of a running serve/replay/train/
+                              bench process.
+* ``report``                — ONE report builder shared by the serve
+                              exit summary and ``replay`` records, so
+                              the recovery/chaos counters never drift
+                              between the two again.
+"""
+
+from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.metrics_export import MetricsFlusher, config_digest
+from tuplewise_tpu.obs.report import recovery_counters, service_report
+from tuplewise_tpu.obs.tracing import Span, Tracer
+
+__all__ = [
+    "FlightRecorder",
+    "MetricsFlusher",
+    "Span",
+    "Tracer",
+    "config_digest",
+    "recovery_counters",
+    "service_report",
+]

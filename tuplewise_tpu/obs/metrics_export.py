@@ -1,0 +1,141 @@
+"""Live metrics export: periodic whole-registry snapshots to JSONL
+[ISSUE 6 tentpole].
+
+The metrics registries built in PRs 1-5 are only ever read at exit —
+a live serve process is a black box until it stops. The
+:class:`MetricsFlusher` is a side thread that appends one registry
+snapshot per cadence tick to a JSONL path, each stamped with wall AND
+monotonic timestamps (wall for humans/joins, monotonic for rate
+computations across NTP steps), the jax platform, and a config digest
+(so rows from different configs never get silently averaged together).
+
+Durability stance: appends are flushed (``write`` + ``flush``) but NOT
+fsync'd — metrics are a lossy observability stream, not durable state;
+an fsync per tick would put a disk sync on the observation path of the
+very latency it reports. (The WAL keeps its own fsync policy; see
+DESIGN §9.)
+
+``flush()`` is also called once at ``start()`` and once at ``stop()``,
+so even a short run leaves >= 2 snapshots — enough to difference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+def config_digest(config) -> str:
+    """Short stable digest of a config mapping/dataclass — the join key
+    that keeps metrics rows from different configs apart."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(config) and not isinstance(config, type):
+        config = dataclasses.asdict(config)
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def _platform() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:   # noqa: BLE001 — metrics must not require jax
+        return "unknown"
+
+
+class MetricsFlusher:
+    """Side-thread JSONL appender for a ``MetricsRegistry``.
+
+    Args:
+      registry: the ``utils.profiling.MetricsRegistry`` to snapshot.
+      path: JSONL output (parent dirs created; appended, not truncated
+        — restarts of the same service extend one history file).
+      every_s: cadence between snapshots.
+      meta: extra fields stamped on every row (e.g. ``stage``); the
+        platform and ``config_digest`` ride along automatically when
+        ``config`` is given.
+      config: config object/dict digested into ``config_digest``.
+
+    Use as a context manager, or ``start()`` / ``stop()``.
+    """
+
+    def __init__(self, registry, path: str, every_s: float = 1.0,
+                 meta: Optional[dict] = None, config=None):
+        if every_s <= 0:
+            raise ValueError(f"every_s must be > 0: {every_s}")
+        self.registry = registry
+        self.path = path
+        self.every_s = every_s
+        self.meta = dict(meta or {})
+        self.meta.setdefault("platform", _platform())
+        if config is not None:
+            self.meta.setdefault("config_digest", config_digest(config))
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()    # serializes appends
+        self._f = None
+        self.last_flush_error: Optional[str] = None
+
+    # ------------------------------------------------------------------ #
+    def flush(self) -> int:
+        """Append one snapshot row now; returns its seq number. Never
+        raises (the error lands in ``last_flush_error``) — a full disk
+        must not take the service down."""
+        with self._lock:
+            self._seq += 1
+            row = {
+                "seq": self._seq,
+                "ts_wall": time.time(),
+                "ts_mono": time.perf_counter(),
+            }
+            row.update(self.meta)
+            row["metrics"] = self.registry.snapshot()
+            try:
+                if self._f is None:
+                    d = os.path.dirname(self.path)
+                    if d:
+                        os.makedirs(d, exist_ok=True)
+                    self._f = open(self.path, "a", encoding="utf-8")
+                self._f.write(json.dumps(row) + "\n")
+                self._f.flush()
+            except Exception as e:   # noqa: BLE001 — lossy by design
+                self.last_flush_error = repr(e)
+            return self._seq
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.every_s):
+            self.flush()
+
+    def start(self) -> "MetricsFlusher":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self.flush()     # row 1: the starting state
+            self._thread = threading.Thread(
+                target=self._run, name="tuplewise-metrics-flusher",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        self.flush()         # final row: the exit state
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self) -> "MetricsFlusher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,125 @@
+"""ONE report builder for serve exit summaries and replay records
+[ISSUE 6 satellite].
+
+Before this module, ``tuplewise serve``'s exit summary and
+``serving.replay``'s record each hand-picked recovery/chaos counters
+from the metrics snapshot — and drifted (replay's ``faults`` block
+carried ``shard_retries_total`` but not ``major_merge_fallbacks``; the
+serve summary the reverse). Both now call :func:`service_report` /
+:func:`recovery_counters` on the same registry snapshot, and a parity
+test pins the key sets together.
+
+All inputs are the plain-dict output of ``MetricsRegistry.snapshot()``
+— the builder never touches live objects, so it also works on a
+metrics.jsonl row or a post-mortem snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# the insert-latency decomposition [ISSUE 6 tentpole]: consecutive
+# boundary timestamps in the engine's insert apply path, so the stage
+# values of one request sum EXACTLY to its measured insert latency
+INSERT_STAGES = ("queue_wait", "coalesce", "wal_append", "index_insert",
+                 "stream_extend", "snapshot", "resolve")
+
+
+def stage_metric(stage: str) -> str:
+    return f"insert_stage_{stage}_s"
+
+
+# the recovery/chaos counter set BOTH reports carry — extend here, and
+# serve + replay + bench stay in lockstep
+_RECOVERY_COUNTERS = (
+    "reshard_events",
+    "shard_retries_total",
+    "bg_compactor_restarts",
+    "batcher_restarts",
+    "major_merge_fallbacks",
+    "poison_rejects",
+    "deadline_expired_total",
+)
+
+
+def _v(m: dict, name: str):
+    return m.get(name, {}).get("value", 0)
+
+
+def _p_ms(m: dict, name: str, q: str):
+    v = m.get(name, {}).get(q)
+    return None if v is None else v * 1e3
+
+
+def recovery_counters(metrics: dict) -> dict:
+    """The unified recovery/chaos counter block (replay's ``faults``
+    block and part of the serve exit summary)."""
+    return {name: _v(metrics, name) for name in _RECOVERY_COUNTERS}
+
+
+def stage_p99_ms(metrics: dict) -> dict:
+    """Per-stage insert-latency p99s (ms), one entry per stage that
+    recorded at least one sample."""
+    out = {}
+    for stage in INSERT_STAGES:
+        p = _p_ms(metrics, stage_metric(stage), "p99")
+        if p is not None:
+            out[stage] = p
+    return out
+
+
+def stage_attribution(metrics: dict) -> Optional[dict]:
+    """How completely the stage decomposition accounts for measured
+    insert latency: stage sums vs the ``insert_latency_s`` sum. The
+    stages are consecutive intervals of each request's lifetime, so
+    ``coverage`` is 1.0 up to float rounding — a materially lower
+    value means an unattributed stage crept into the path."""
+    total = metrics.get("insert_latency_s", {})
+    if not total.get("count"):
+        return None
+    attributed = sum(
+        metrics.get(stage_metric(s), {}).get("sum", 0.0)
+        for s in INSERT_STAGES)
+    return {
+        "attributed_s": attributed,
+        "measured_s": total["sum"],
+        "coverage": (attributed / total["sum"]) if total["sum"] else None,
+    }
+
+
+def service_report(metrics: dict, chaos=None,
+                   flight=None) -> dict:
+    """The shared serving report: load-shedding, compaction, transfer,
+    latency (with per-stage p99 attribution), and recovery counters —
+    the block ``tuplewise serve`` prints as its exit summary and
+    ``replay`` embeds as ``report``.
+
+    Args:
+      metrics: ``MetricsRegistry.snapshot()`` output.
+      chaos: optional ``FaultInjector`` — its ``snapshot()`` rides
+        along under ``"chaos"``.
+      flight: optional ``FlightRecorder`` — per-kind event counts ride
+        along under ``"flight_events"``.
+    """
+    report = {
+        "rejected_total": _v(metrics, "rejected_total"),
+        "dropped_total": _v(metrics, "dropped_total"),
+        "compactions_total": _v(metrics, "compactions_total"),
+        "compaction_pause_p99_ms": _p_ms(metrics, "compaction_pause_s",
+                                         "p99"),
+        "compaction_pause_max_ms": _p_ms(metrics, "compaction_pause_s",
+                                         "max"),
+        "insert_latency_p99_ms": _p_ms(metrics, "insert_latency_s",
+                                       "p99"),
+        "insert_stage_p99_ms": stage_p99_ms(metrics),
+        "stage_attribution": stage_attribution(metrics),
+        "bytes_h2d": _v(metrics, "bytes_h2d"),
+        "bytes_h2d_saved": _v(metrics, "bytes_h2d_saved"),
+        "major_merges_total": _v(metrics, "major_merges_total"),
+    }
+    report.update(recovery_counters(metrics))
+    if chaos is not None:
+        report["chaos"] = chaos.snapshot()
+    if flight is not None:
+        report["flight_events"] = flight.counts()
+    return report

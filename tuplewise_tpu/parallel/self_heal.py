@@ -112,12 +112,19 @@ class MeshHealer:
         ``recovery_time_s`` into (create-or-return, so the serving
         index shares its registry); None = a private one.
       backoff: a :class:`Backoff`; None = defaults.
+      tracer: an ``obs.tracing.Tracer`` — each heal round becomes a
+        ``heal.round`` span (probe/reshard children) in whatever trace
+        triggered the recovery [ISSUE 6]; None = no spans.
+      flight: an ``obs.flight.FlightRecorder`` — every heal round and
+        exhaustion records a lifecycle event with the correlating
+        trace id; None = no events.
     """
 
     def __init__(self, mesh=None, *, fixed_width: Optional[int] = None,
                  pool: Optional[Sequence] = None, chaos=None,
                  probe_timeout_s: float = 5.0, metrics=None,
-                 backoff: Optional[Backoff] = None):
+                 backoff: Optional[Backoff] = None, tracer=None,
+                 flight=None):
         from tuplewise_tpu.utils.profiling import MetricsRegistry
 
         if fixed_width is not None and mesh is None:
@@ -127,6 +134,8 @@ class MeshHealer:
         self.chaos = chaos
         self.probe_timeout_s = probe_timeout_s
         self.backoff = backoff if backoff is not None else Backoff()
+        self.tracer = tracer
+        self.flight = flight
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._c_reshard = self.metrics.counter("reshard_events")
         self._c_retries = self.metrics.counter("shard_retries_total")
@@ -210,14 +219,23 @@ class MeshHealer:
         (``on_heal(self)`` — device buffers may be torn even when the
         mesh itself survived, so re-placement is unconditional), record
         the recovery, back off. Returns True when the mesh changed."""
+        from tuplewise_tpu.obs.tracing import maybe_span
+
         changed = False
         if self.mesh is not None:
             t0 = time.perf_counter()
-            changed = self._reshard()
-            if on_heal is not None:
-                on_heal(self)
+            with maybe_span(self.tracer, "heal.round", attempt=attempt):
+                with maybe_span(self.tracer, "heal.probe_reshard"):
+                    changed = self._reshard()
+                if on_heal is not None:
+                    on_heal(self)
             self._c_reshard.inc()
-            self._h_recovery.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._h_recovery.observe(dt)
+            if self.flight is not None:
+                self.flight.record(
+                    "heal", attempt=attempt, mesh_changed=changed,
+                    mesh_width=self.n_workers, recovery_s=dt)
         elif on_heal is not None:
             on_heal(self)
         self.backoff.sleep(attempt)

@@ -21,10 +21,28 @@ whatever else arrives within ``flush_timeout_s`` up to ``max_batch``
   * "block"       — the submitting thread waits for capacity
                     (backpressure propagates upstream).
 
-Observability: every engine owns a ``MetricsRegistry`` (no process
-globals) with request/batch counters and latency / batch-fill /
-queue-depth histograms; ``stats()`` snapshots everything plus the
-index/streaming state in one JSON-able dict.
+Observability [ISSUE 6]: every engine owns a ``MetricsRegistry`` (no
+process globals) with request/batch counters, latency / batch-fill /
+queue-depth histograms, live gauges (queue depth, inflight requests),
+and **per-stage insert-latency attribution**: the apply path records
+consecutive boundary timestamps (queue_wait → coalesce → wal_append →
+index_insert → stream_extend → snapshot → resolve), so each request's
+stage values sum exactly to its measured insert latency — the exit
+summary and replay records report p99 per stage. ``stats()`` snapshots
+everything plus the index/streaming state in one JSON-able dict.
+
+A ``tracer=`` (``obs.tracing.Tracer``) threads trace context through
+the full request path: submit opens a per-request root span, the
+batcher parents its apply span to the coalesced run's first request,
+and the stage intervals land as child spans — exportable as Chrome
+trace JSON so perfetto renders the serving timeline. Off by default:
+``tracer=None`` costs one ``is not None`` check per hook.
+
+Every engine also owns a ``FlightRecorder`` — a bounded ring of
+lifecycle events (poison rejects, deadline expiries, batcher restarts,
+compactions, heals, snapshot seals, chaos injections) with trace-id
+correlation, auto-dumped next to the recovery snapshots on close /
+crash so post-SIGKILL forensics see what the process was doing.
 
 Lifecycle hardening [ISSUE 3]: the batcher worker runs under a
 supervisor that restarts it if it dies (``batcher_restarts``);
@@ -43,6 +61,7 @@ state periodically (``serving/recovery.py``).
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -51,6 +70,9 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.report import INSERT_STAGES, stage_metric
+from tuplewise_tpu.obs.tracing import maybe_span
 from tuplewise_tpu.serving.index import ExactAucIndex
 from tuplewise_tpu.serving.streaming import StreamingIncompleteU
 from tuplewise_tpu.utils.profiling import MetricsRegistry
@@ -110,6 +132,9 @@ class ServingConfig:
     # tail since the last snapshot; "batch" fsyncs every append,
     # closing that window at per-batch fsync latency (DESIGN §9).
     wal_fsync: str = "snapshot"
+    # flight recorder [ISSUE 6]: lifecycle-event ring size; the dump
+    # lands next to the recovery snapshots when snapshot_dir is set
+    flight_recorder_size: int = 4096
     seed: int = 0
 
     def __post_init__(self):
@@ -136,17 +161,24 @@ class ServingConfig:
             raise ValueError(
                 f"wal_fsync must be 'snapshot' or 'batch': "
                 f"{self.wal_fsync!r}")
+        if self.flight_recorder_size < 1:
+            raise ValueError(
+                f"flight_recorder_size must be >= 1: "
+                f"{self.flight_recorder_size}")
 
 
 class _Request:
-    __slots__ = ("kind", "scores", "labels", "future", "t_enqueue")
+    __slots__ = ("kind", "scores", "labels", "future", "t_enqueue",
+                 "span")
 
-    def __init__(self, kind: str, scores, labels):
+    def __init__(self, kind: str, scores, labels, span=None):
         self.kind = kind
         self.scores = scores
         self.labels = labels
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        # per-request trace root [ISSUE 6]; None when tracing is off
+        self.span = span
 
 
 class MicroBatchEngine:
@@ -157,14 +189,26 @@ class MicroBatchEngine:
     """
 
     def __init__(self, config: Optional[ServingConfig] = None,
-                 chaos=None, **overrides):
+                 chaos=None, tracer=None, **overrides):
         if config is None:
             config = ServingConfig(**overrides)
         elif overrides:
             config = dataclasses.replace(config, **overrides)
         self.config = config
         self.chaos = chaos
+        self.tracer = tracer
         self.metrics = MetricsRegistry()
+        # flight recorder [ISSUE 6]: lifecycle events with trace-id
+        # correlation; when recovery is configured the auto-dump lands
+        # NEXT TO the snapshots, so post-SIGKILL forensics start from
+        # one directory
+        self.flight = FlightRecorder(
+            capacity=config.flight_recorder_size, tracer=tracer,
+            dump_path=(os.path.join(config.snapshot_dir, "flight.jsonl")
+                       if config.snapshot_dir else None))
+        if chaos is not None:
+            # every injected fault logs a correlated flight event
+            chaos.attach(flight=self.flight, tracer=tracer)
         # the index records compactions_total / compaction_pause_s into
         # the engine's registry, so stats() carries the pause histogram
         self.index = ExactAucIndex(
@@ -173,6 +217,7 @@ class MicroBatchEngine:
             bg_compact=config.bg_compact, metrics=self.metrics,
             chaos=chaos, delta_fraction=config.delta_fraction,
             max_delta_runs=config.max_delta_runs,
+            tracer=tracer, flight=self.flight,
         ) if config.kernel == "auc" else None
         self.streaming = StreamingIncompleteU(
             kernel=config.kernel, budget=config.budget,
@@ -198,6 +243,15 @@ class MicroBatchEngine:
         self._h_depth = m.histogram(
             "queue_depth", buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256,
                                     512, 1024, 2048])
+        # insert-latency stage attribution [ISSUE 6]: consecutive
+        # boundary timestamps of the apply path; one request's stage
+        # values sum exactly to its measured insert latency
+        self._h_stage = {s: m.histogram(stage_metric(s))
+                         for s in INSERT_STAGES}
+        # live gauges [ISSUE 6 satellite]: the current reading, not the
+        # cumulative history — what the MetricsFlusher streams out
+        self._g_depth = m.gauge("queue_depth_live")
+        self._g_inflight = m.gauge("inflight_requests")
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue(
             maxsize=config.queue_size)
         self._lock = threading.Lock()   # guards estimator state
@@ -210,7 +264,8 @@ class MicroBatchEngine:
 
             self._recovery = RecoveryManager(
                 config.snapshot_dir, snapshot_every=config.snapshot_every,
-                wal_fsync=config.wal_fsync)
+                wal_fsync=config.wal_fsync, tracer=tracer,
+                flight=self.flight)
             if config.recover:
                 self._recovery.recover(self)
             else:
@@ -238,7 +293,18 @@ class MicroBatchEngine:
             scores, labels = self._validate_insert(scores, labels)
         elif kind == "score":
             scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
-        req = _Request(kind, scores, labels)
+        # trace context is born HERE [ISSUE 6]: one root span per
+        # request, handed through the queue so the batcher's apply
+        # spans continue this trace on its own thread
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start(f"request.{kind}", parent=None)
+        req = _Request(kind, scores, labels, span=span)
+        if span is not None:
+            # anchor the root to t_enqueue, the same reading every
+            # stage boundary measures from — child stage spans then
+            # tile the root EXACTLY (the >= 95% smoke is really == 100%)
+            span.t0 = req.t_enqueue
         self._c_req[kind].inc()
         policy = self.config.policy
         if policy == "block":
@@ -269,6 +335,12 @@ class MicroBatchEngine:
                 self._q.put(req)
         return req.future
 
+    def _poison(self, msg: str) -> None:
+        """Count + flight-record + raise one poison rejection."""
+        self._c_poison.inc()
+        self.flight.record("poison_reject", reason=msg)
+        raise PoisonEventError(msg)
+
     def _validate_insert(self, scores, labels):
         """Edge validation [ISSUE 3]: poison events — NaN/inf scores,
         non-finite labels, shape mismatches — must fail the SUBMITTER
@@ -277,17 +349,14 @@ class MicroBatchEngine:
         scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
         labels = np.atleast_1d(np.asarray(labels))
         if scores.shape != labels.shape:
-            self._c_poison.inc()
-            raise PoisonEventError(
+            self._poison(
                 f"insert: scores/labels shape mismatch: {scores.shape} "
                 f"vs {labels.shape}")
         if len(scores) and not np.all(np.isfinite(scores)):
-            self._c_poison.inc()
-            raise PoisonEventError("insert: non-finite score(s) rejected")
+            self._poison("insert: non-finite score(s) rejected")
         if labels.dtype.kind == "f" and len(labels) \
                 and not np.all(np.isfinite(labels)):
-            self._c_poison.inc()
-            raise PoisonEventError("insert: non-finite label(s) rejected")
+            self._poison("insert: non-finite label(s) rejected")
         return scores, labels
 
     def insert(self, scores, labels) -> Future:
@@ -316,10 +385,12 @@ class MicroBatchEngine:
             try:
                 self._run()
                 return
-            except BaseException:
+            except BaseException as e:
                 if self._closed:
                     return
                 self._c_batcher_restarts.inc()
+                self.flight.record("batcher_restart", error=repr(e))
+                self.flight.auto_dump()
 
     def _run(self) -> None:
         while True:
@@ -337,7 +408,13 @@ class MicroBatchEngine:
             if first is None or self._closed:
                 self._fail_queued(first)
                 return
-            self._h_depth.observe(self._q.qsize() + 1)
+            # the queue-depth gauge updates HERE, where qsize is being
+            # read anyway — never on the submit hot path (qsize takes
+            # the queue mutex; a per-submit read would contend with
+            # this very drain loop)
+            depth = self._q.qsize() + 1
+            self._h_depth.observe(depth)
+            self._g_depth.set(depth)
             batch = [first]
             deadline = time.perf_counter() + self.config.flush_timeout_s
             while len(batch) < self.config.max_batch:
@@ -367,6 +444,9 @@ class MicroBatchEngine:
         while True:
             if r is not None and not r.future.done():
                 r.future.set_exception(exc)
+                if self.tracer is not None and r.span is not None:
+                    self.tracer.finish(r.span)
+                    r.span = None
             try:
                 r = self._q.get_nowait()
             except queue.Empty:
@@ -377,6 +457,7 @@ class MicroBatchEngine:
             batch = self._expire(batch)
             if not batch:
                 return
+        self._g_inflight.set(self._q.qsize() + len(batch))
         self._c_batches.inc()
         self._h_fill.observe(len(batch) / self.config.max_batch)
         for kind, run in self._runs(batch):
@@ -396,8 +477,13 @@ class MicroBatchEngine:
             now = time.perf_counter()
             for r in run:
                 self._h_latency.observe(now - r.t_enqueue)
-                if kind == "insert":
-                    self._h_insert_lat.observe(now - r.t_enqueue)
+                # insert spans/latency are finished inside
+                # _apply_inserts at the exact stage-boundary t_end;
+                # score/query (and failed-run) spans end here
+                if self.tracer is not None and r.span is not None:
+                    self.tracer.finish(r.span, now)
+                    r.span = None
+        self._g_inflight.set(self._q.qsize())
 
     def _expire(self, batch: List[_Request]) -> List[_Request]:
         """Deadline enforcement at dispatch [ISSUE 3]: a request that
@@ -409,11 +495,19 @@ class MicroBatchEngine:
         for r in batch:
             if now - r.t_enqueue > self.config.deadline_s:
                 self._c_deadline.inc()
+                self.flight.record(
+                    "deadline_expired", kind_req=r.kind,
+                    waited_s=now - r.t_enqueue,
+                    trace_id=(r.span.trace_id if r.span is not None
+                              else None))
                 if not r.future.done():
                     r.future.set_exception(DeadlineExceededError(
                         f"request expired after {now - r.t_enqueue:.3f}s "
                         f"in queue (deadline_s="
                         f"{self.config.deadline_s})"))
+                if self.tracer is not None and r.span is not None:
+                    self.tracer.finish(r.span, now)
+                    r.span = None
             else:
                 live.append(r)
         return live
@@ -431,23 +525,76 @@ class MicroBatchEngine:
         return runs
 
     def _apply_inserts(self, run: List[_Request]) -> None:
+        # stage boundaries [ISSUE 6]: consecutive perf_counter readings
+        # tile each request's [enqueue, resolve] lifetime, so stage
+        # values sum EXACTLY to the measured insert latency
+        t_start = time.perf_counter()            # queue_wait ends
         scores = np.concatenate([r.scores for r in run])
         labels = np.concatenate([r.labels for r in run]).astype(bool)
-        with self._lock:
-            if self._recovery is not None:
-                # write-ahead: the WAL records the batch BEFORE it is
-                # applied, so a crash mid-apply replays it on recovery
-                # (an admitted event is never lost)
-                self._recovery.record(scores, labels)
-            if self.index is not None:
-                self.index.insert_batch(scores, labels)
-            spent = self.streaming.extend(scores, labels)
-            if self._recovery is not None:
-                self._recovery.maybe_snapshot(self)
+        with maybe_span(self.tracer, "insert.apply",
+                        parent=run[0].span, n_requests=len(run),
+                        n_events=len(scores)):
+            with self._lock:
+                t_lock = time.perf_counter()     # coalesce = concat+lock
+                if self._recovery is not None:
+                    # write-ahead: the WAL records the batch BEFORE it
+                    # is applied, so a crash mid-apply replays it on
+                    # recovery (an admitted event is never lost)
+                    self._recovery.record(scores, labels)
+                t_wal = time.perf_counter()
+                if self.index is not None:
+                    self.index.insert_batch(scores, labels)
+                t_index = time.perf_counter()
+                spent = self.streaming.extend(scores, labels)
+                t_stream = time.perf_counter()
+                if self._recovery is not None:
+                    self._recovery.maybe_snapshot(self)
+                t_snap = time.perf_counter()
         self._c_events.inc(len(scores))
         self._c_pairs.inc(spent)
         for r in run:
             r.future.set_result(len(r.scores))
+        t_end = time.perf_counter()              # resolve ends
+        n = len(run)
+        h = self._h_stage
+        h["coalesce"].observe_n(t_lock - t_start, n)
+        h["wal_append"].observe_n(t_wal - t_lock, n)
+        h["index_insert"].observe_n(t_index - t_wal, n)
+        h["stream_extend"].observe_n(t_stream - t_index, n)
+        h["snapshot"].observe_n(t_snap - t_stream, n)
+        h["resolve"].observe_n(t_end - t_snap, n)
+        qw = h["queue_wait"]
+        for r in run:
+            qw.observe(t_start - r.t_enqueue)
+            self._h_insert_lat.observe(t_end - r.t_enqueue)
+        if self.tracer is not None:
+            self._trace_insert_run(
+                run, (t_start, t_lock, t_wal, t_index, t_stream,
+                      t_snap, t_end))
+
+    def _trace_insert_run(self, run: List[_Request], ts) -> None:
+        """Per-request stage spans [ISSUE 6]: every insert's trace gets
+        the consecutive stage intervals as children of its root span.
+        Because the children tile [enqueue, resolve], per-trace child
+        durations sum to the root's duration by construction — the
+        property the observability smoke asserts at >= 95%."""
+        t_start, t_lock, t_wal, t_index, t_stream, t_snap, t_end = ts
+        tr = self.tracer
+        bounds = (("coalesce", t_start, t_lock),
+                  ("wal_append", t_lock, t_wal),
+                  ("index_insert", t_wal, t_index),
+                  ("stream_extend", t_index, t_stream),
+                  ("snapshot", t_stream, t_snap),
+                  ("resolve", t_snap, t_end))
+        for r in run:
+            if r.span is None:
+                continue
+            tr.record_span("insert.queue_wait", r.t_enqueue, t_start,
+                           parent=r.span)
+            for name, a, b in bounds:
+                tr.record_span(f"insert.{name}", a, b, parent=r.span)
+            tr.finish(r.span, t_end)
+            r.span = None
 
     def _apply_scores(self, run: List[_Request]) -> None:
         if self.index is None:
@@ -455,8 +602,10 @@ class MicroBatchEngine:
                 "score requests need the exact AUC index "
                 "(kernel='auc')")
         scores = np.concatenate([r.scores for r in run])
-        with self._lock:
-            ranks = self.index.score_batch(scores)
+        with maybe_span(self.tracer, "score.apply",
+                        parent=run[0].span, n_requests=len(run)):
+            with self._lock:
+                ranks = self.index.score_batch(scores)
         off = 0
         for r in run:
             n = len(r.scores)
@@ -496,6 +645,10 @@ class MicroBatchEngine:
             self._recovery.checkpoint_and_close(self)
         if self.index is not None:
             self.index.close(timeout=timeout)
+        # flight forensics [ISSUE 6]: the close dump is the "what was
+        # it doing" record the next --recover session reads first
+        self.flight.record("engine_closed")
+        self.flight.auto_dump()
 
     def __enter__(self) -> "MicroBatchEngine":
         return self

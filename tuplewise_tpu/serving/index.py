@@ -122,6 +122,8 @@ from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
+from tuplewise_tpu.obs.tracing import maybe_span
+
 _MIN_BUCKET = 256
 
 
@@ -312,7 +314,8 @@ class ExactAucIndex:
                  shard_retries: int = 3, retry_backoff_s: float = 0.02,
                  probe_timeout_s: float = 5.0,
                  delta_fraction: float = 0.25,
-                 max_delta_runs: int = 64):
+                 max_delta_runs: int = 64,
+                 tracer=None, flight=None):
         if engine not in ("jax", "numpy"):
             raise ValueError(f"engine must be 'jax' or 'numpy': {engine!r}")
         if window is not None and window < 2:
@@ -364,8 +367,18 @@ class ExactAucIndex:
         )
 
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # observability [ISSUE 6]: span tracing + flight recorder are
+        # optional references owned by the engine (or a test); every
+        # hook costs one `is not None` check when absent
+        self.tracer = tracer
+        self.flight = flight
         self._c_compactions = self.metrics.counter("compactions_total")
         self._h_pause = self.metrics.histogram("compaction_pause_s")
+        # live container gauges [ISSUE 6 satellite]
+        self._g_delta = self.metrics.gauge("delta_run_events")
+        self._g_tomb = self.metrics.gauge("tombstone_occupancy")
+        self._g_mesh = self.metrics.gauge("mesh_width")
+        self._g_mesh.set(shards if shards is not None else 0)
         # transfer accounting [ISSUE 5]: host->device bytes are the
         # serving-side shuffle budget; place_base feeds the counters,
         # minor compactions feed the per-event histogram
@@ -402,7 +415,8 @@ class ExactAucIndex:
             self._healer = MeshHealer(
                 self._mesh, chaos=chaos,
                 probe_timeout_s=probe_timeout_s, metrics=self.metrics,
-                backoff=Backoff(base_s=retry_backoff_s, cap_s=1.0))
+                backoff=Backoff(base_s=retry_backoff_s, cap_s=1.0),
+                tracer=tracer, flight=flight)
         # one re-entrant lock guards ALL container structure; the
         # condition signals build completion (compact() drains on it).
         # Synchronous mode takes the same (uncontended) lock — one code
@@ -463,6 +477,7 @@ class ExactAucIndex:
         bit-identical (counting is additive over any partition), so a
         healed query returns exactly what the healthy mesh would have.
         """
+        from tuplewise_tpu.parallel.self_heal import HealExhaustedError
         from tuplewise_tpu.parallel.sharded_counts import sharded_counts
 
         from tuplewise_tpu.parallel.sharded_counts import next_bucket
@@ -478,8 +493,19 @@ class ExactAucIndex:
                                   q, self.dtype, chaos=self.chaos,
                                   deltas=deltas)
 
-        return self._healer.run(attempt, retries=self.shard_retries,
-                                on_heal=self._on_heal)
+        try:
+            with maybe_span(self.tracer, "index.sharded_count",
+                            n_queries=len(q)):
+                return self._healer.run(attempt,
+                                        retries=self.shard_retries,
+                                        on_heal=self._on_heal)
+        except HealExhaustedError as e:
+            # terminal for this mesh: dump the flight ring NOW — the
+            # operator's first question is what led up to exhaustion
+            if self.flight is not None:
+                self.flight.record("heal_exhausted", error=repr(e))
+                self.flight.auto_dump()
+            raise
 
     def _on_heal(self, healer) -> None:
         """Re-placement after a heal round: adopt the (possibly
@@ -488,10 +514,12 @@ class ExactAucIndex:
         rebuild)."""
         self._mesh = healer.mesh
         self.shards = healer.n_workers
-        for side in (self._pos, self._neg):
-            side.placed_base = None   # stale mesh: no row reuse
-            self._place(side)
-            self._replace_deltas(side)
+        self._g_mesh.set(self.shards)
+        with maybe_span(self.tracer, "heal.replace"):
+            for side in (self._pos, self._neg):
+                side.placed_base = None   # stale mesh: no row reuse
+                self._place(side)
+                self._replace_deltas(side)
 
     def _replace_deltas(self, side: _ClassSide) -> None:
         """Rebuild the delta run's device placement (mesh change or
@@ -630,6 +658,22 @@ class ExactAucIndex:
                 except ValueError:
                     side.tomb.append(v)
         self.n_evicted += count
+        self._update_gauges()
+
+    def _side_name(self, side: _ClassSide) -> str:
+        return "pos" if side is self._pos else "neg"
+
+    def _update_gauges(self) -> None:
+        """Refresh the live container gauges (caller holds the lock or
+        owns the containers) [ISSUE 6 satellite]."""
+        self._g_delta.set(len(self._pos.delta_run)
+                          + len(self._neg.delta_run))
+        self._g_tomb.set(len(self._pos.tomb_run) + len(self._neg.tomb_run)
+                         + len(self._pos.tomb) + len(self._neg.tomb))
+
+    def _flight_event(self, kind: str, **fields) -> None:
+        if self.flight is not None:
+            self.flight.record(kind, **fields)
 
     def _maybe_compact(self) -> None:
         bg_ok = self._ensure_compactor() if self.bg_compact else False
@@ -774,22 +818,25 @@ class ExactAucIndex:
         and the pause it bills to the caller — runs inline. Delta mode
         makes that pause O(b): a minor compaction, then whatever
         follow-up tier is due."""
-        if not self._delta:
-            self._full_compact(side)
-            return
-        buf_vals, tomb_vals = list(side.buf), list(side.tomb)
-        side.buf = []
-        side.tomb = []
-        t0 = time.perf_counter()
-        new_delta, placed = self._build_delta(side, buf_vals)
-        self._commit_minor(side, new_delta, placed, tomb_vals, t0)
-        todo = self._followup(side)
-        if todo == "major":
+        with maybe_span(self.tracer, "compaction.sync",
+                        side=self._side_name(side)):
+            if not self._delta:
+                self._full_compact(side)
+                return
+            buf_vals, tomb_vals = list(side.buf), list(side.tomb)
+            side.buf = []
+            side.tomb = []
             t0 = time.perf_counter()
-            merged, dev, cap = self._major_build(side)
-            self._commit_major(side, merged, dev, cap, t0, t0)
-        elif todo == "full":
-            self._full_compact(side)
+            new_delta, placed = self._build_delta(side, buf_vals)
+            self._commit_minor(side, new_delta, placed, tomb_vals, t0)
+            todo = self._followup(side)
+            if todo == "major":
+                t0 = time.perf_counter()
+                with maybe_span(self.tracer, "compaction.major"):
+                    merged, dev, cap = self._major_build(side)
+                    self._commit_major(side, merged, dev, cap, t0, t0)
+            elif todo == "full":
+                self._full_compact(side)
 
     def _build_delta(self, side: _ClassSide, buf_vals: List[float]):
         """Merge the pending buffer into the consolidated delta run —
@@ -853,6 +900,11 @@ class ExactAucIndex:
                 np.sort(np.asarray(tomb_vals, dtype=self.dtype)))
         self.n_compactions += 1
         self._c_compactions.inc()
+        self._update_gauges()
+        self._flight_event(
+            "compaction", tier="minor", side=self._side_name(side),
+            delta_events=len(side.delta_run),
+            bytes_shipped=(placed[2] if placed is not None else 0))
         self._h_pause.observe(time.perf_counter() - t0)
 
     def _followup(self, side: _ClassSide) -> Optional[str]:
@@ -909,6 +961,9 @@ class ExactAucIndex:
                 except Exception as e:   # noqa: BLE001 — fallback path
                     self._c_major_fb.inc()
                     self.last_major_merge_error = repr(e)
+                    self._flight_event(
+                        "major_merge_fallback",
+                        side=self._side_name(side), error=repr(e))
         # S=1 / empty-base / out-of-plan / failed-mesh fallback: the
         # host engine re-places the merged run in full
         dev, cap_out, _ = place_base(self._mesh, merged, self.dtype,
@@ -933,7 +988,11 @@ class ExactAucIndex:
         self._c_compactions.inc()
         self.n_major_merges += 1
         self._c_major.inc()
+        self._update_gauges()
         now = time.perf_counter()
+        self._flight_event(
+            "major_merge", side=self._side_name(side),
+            base_events=len(merged), build_s=now - t_build0)
         self._h_major.observe(now - t_build0)
         self._h_pause.observe(now - t_pause0)
 
@@ -969,6 +1028,10 @@ class ExactAucIndex:
             self._h_compaction_bytes.observe(shipped)
         self.n_compactions += 1
         self._c_compactions.inc()
+        self._update_gauges()
+        self._flight_event(
+            "compaction", tier="full", side=self._side_name(side),
+            base_events=len(merged), bytes_shipped=shipped)
         self._h_pause.observe(time.perf_counter() - t0)
 
     # ------------------------------------------------------------------ #
@@ -1011,52 +1074,68 @@ class ExactAucIndex:
     def _build_and_swap(self, side: _ClassSide) -> None:
         if self._bg_test_hook is not None:
             self._bg_test_hook(side)
-        if self.chaos is not None:
-            self.chaos.fire("compactor_build")
-        if self._delta:
-            self._bg_delta_build(side)
-            return
-        with self._cv:
-            base = side.base
-            prev = (side.placed_base, side.base_dev, side.cap)
-            buf_snap = list(side.buf[: side.snap_buf])
-            tomb_snap = list(side.tomb[: side.snap_tomb])
-        # the expensive part — merge + device placement — runs with
-        # the lock RELEASED; inserts keep landing in the buffer
-        merged = self._merge(base, buf_snap, tomb_snap,
-                             on_thread=False)
-        if self.shards is not None and len(merged):
-            from tuplewise_tpu.parallel.sharded_counts import place_base
+        with maybe_span(self.tracer, "compactor.build",
+                        side=self._side_name(side)) as bspan:
+            if self.chaos is not None:
+                self.chaos.fire("compactor_build")
+            if self._delta:
+                self._bg_delta_build(side)
+                return
+            with self._cv:
+                base = side.base
+                prev = (side.placed_base, side.base_dev, side.cap)
+                buf_snap = list(side.buf[: side.snap_buf])
+                tomb_snap = list(side.tomb[: side.snap_tomb])
+            # the expensive part — merge + device placement — runs with
+            # the lock RELEASED; inserts keep landing in the buffer
+            with maybe_span(self.tracer, "compactor.merge",
+                            n_buf=len(buf_snap)):
+                merged = self._merge(base, buf_snap, tomb_snap,
+                                     on_thread=False)
+            if self.shards is not None and len(merged):
+                from tuplewise_tpu.parallel.sharded_counts import (
+                    place_base,
+                )
 
-            base_dev, cap, shipped = place_base(
-                self._mesh, merged, self.dtype, prev=prev,
-                metrics=self.metrics, chaos=self.chaos)
-        else:
-            base_dev, cap, shipped = None, 0, 0
-        self._warm_counts(base_dev, cap, ())
-        with self._cv:
-            t0 = time.perf_counter()
-            side.base = merged
-            side.base_dev, side.cap = base_dev, cap
-            side.placed_base = merged if base_dev is not None else None
-            if self.shards is not None:
-                self._h_compaction_bytes.observe(shipped)
-            del side.buf[: side.snap_buf]
-            del side.tomb[: side.snap_tomb]
-            side.snap_buf = side.snap_tomb = 0
-            side.building = False
-            self.n_compactions += 1
-            self._c_compactions.inc()
-            # the swap is the ONLY pause the hot path can observe
-            self._h_pause.observe(time.perf_counter() - t0)
-            # keep draining if the buffer outgrew the threshold
-            # while this build ran
-            buf_pending, tomb_pending = side.pending
-            if (not self._closed
-                    and (buf_pending >= self.compact_every
-                         or tomb_pending >= self.compact_every)):
-                self._submit_compact(side)
-            self._cv.notify_all()
+                with maybe_span(self.tracer, "compactor.place_base"):
+                    base_dev, cap, shipped = place_base(
+                        self._mesh, merged, self.dtype, prev=prev,
+                        metrics=self.metrics, chaos=self.chaos)
+            else:
+                base_dev, cap, shipped = None, 0, 0
+            self._warm_counts(base_dev, cap, ())
+            with self._cv:
+                t0 = time.perf_counter()
+                side.base = merged
+                side.base_dev, side.cap = base_dev, cap
+                side.placed_base = merged if base_dev is not None else None
+                if self.shards is not None:
+                    self._h_compaction_bytes.observe(shipped)
+                del side.buf[: side.snap_buf]
+                del side.tomb[: side.snap_tomb]
+                side.snap_buf = side.snap_tomb = 0
+                side.building = False
+                self.n_compactions += 1
+                self._c_compactions.inc()
+                self._update_gauges()
+                self._flight_event(
+                    "compaction", tier="bg_merge",
+                    side=self._side_name(side),
+                    base_events=len(merged), bytes_shipped=shipped)
+                # the swap is the ONLY pause the hot path can observe
+                t1 = time.perf_counter()
+                self._h_pause.observe(t1 - t0)
+                if self.tracer is not None:
+                    self.tracer.record_span("compactor.swap", t0, t1,
+                                            parent=bspan)
+                # keep draining if the buffer outgrew the threshold
+                # while this build ran
+                buf_pending, tomb_pending = side.pending
+                if (not self._closed
+                        and (buf_pending >= self.compact_every
+                             or tomb_pending >= self.compact_every)):
+                    self._submit_compact(side)
+                self._cv.notify_all()
 
     def _bg_delta_build(self, side: _ClassSide) -> None:
         """Delta-mode background job [ISSUE 5]: an O(b) minor build +
@@ -1069,7 +1148,9 @@ class ExactAucIndex:
             tomb_snap = list(side.tomb[: side.snap_tomb])
         # O(|delta| + b log b) splice + O(|delta|) placement, lock
         # released (the worker owns delta_run for the whole job)
-        new_delta, placed = self._build_delta(side, buf_snap)
+        with maybe_span(self.tracer, "compactor.minor_build",
+                        n_buf=len(buf_snap)):
+            new_delta, placed = self._build_delta(side, buf_snap)
         if placed is not None:
             self._warm_counts(side.base_dev, side.cap,
                               ((placed[0], placed[1]),))
@@ -1085,7 +1166,8 @@ class ExactAucIndex:
         # the watchdog's sync fallback skips building sides
         if todo == "major":
             t0 = time.perf_counter()
-            merged, dev, cap = self._major_build(side)
+            with maybe_span(self.tracer, "compactor.major_build"):
+                merged, dev, cap = self._major_build(side)
             self._warm_counts(dev, cap, ())
             with self._cv:
                 self._commit_major(side, merged, dev, cap, t0,
@@ -1120,6 +1202,11 @@ class ExactAucIndex:
                 side.tomb_run = np.empty(0, dtype=self.dtype)
                 self.n_compactions += 1
                 self._c_compactions.inc()
+                self._update_gauges()
+                self._flight_event(
+                    "compaction", tier="full",
+                    side=self._side_name(side),
+                    base_events=len(merged))
                 self._h_pause.observe(time.perf_counter() - t0)
         with self._cv:
             side.building = False

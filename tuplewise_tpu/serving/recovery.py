@@ -306,7 +306,7 @@ class RecoveryManager:
 
     def __init__(self, directory: str, snapshot_every: int = 4096,
                  wal_fsync: str = "snapshot",
-                 snapshot_async: bool = True):
+                 snapshot_async: bool = True, tracer=None, flight=None):
         if wal_fsync not in ("snapshot", "batch"):
             raise ValueError(
                 f"wal_fsync must be 'snapshot' or 'batch': {wal_fsync!r}")
@@ -315,6 +315,13 @@ class RecoveryManager:
         self.snapshot_every = snapshot_every
         self.wal_fsync = wal_fsync
         self.snapshot_async = snapshot_async
+        # observability [ISSUE 6]: snapshot/WAL lifecycle goes to the
+        # flight recorder; captures/writes become spans. The flight
+        # ring is ALSO dumped whenever a snapshot lands, so the
+        # forensics file next to snapshot.npz is never older than the
+        # state it explains.
+        self.tracer = tracer
+        self.flight = flight
         self._wal: Optional[EventLog] = None
         self._seq = 0
         self._since_snapshot = 0
@@ -380,9 +387,14 @@ class RecoveryManager:
         # the atomic handoff: capture host copies + seal the live WAL
         # on this (batcher) thread — cheap; the np.savez + fsync +
         # rename runs on the writer thread
+        from tuplewise_tpu.obs.tracing import maybe_span
+
         seq = self._seq
-        extra, cfg = capture_snapshot_state(engine)
-        self._wal.seal(seq)
+        with maybe_span(self.tracer, "snapshot.capture", seq=seq):
+            extra, cfg = capture_snapshot_state(engine)
+            self._wal.seal(seq)
+        if self.flight is not None:
+            self.flight.record("wal_seal", seq=seq)
         self._since_snapshot = 0
         self._ensure_writer()
         self._jobs.put((seq, extra, cfg))
@@ -393,6 +405,10 @@ class RecoveryManager:
         extra, cfg = capture_snapshot_state(engine)
         write_snapshot(self.directory, seq=self._seq, extra=extra,
                        cfg=cfg)
+        if self.flight is not None:
+            self.flight.record("snapshot_landed", seq=self._seq,
+                               mode="sync")
+            self.flight.auto_dump()
         self._prune_segments(self._seq)
         # safe to prune only AFTER the snapshot atomically landed; a
         # crash in between leaves WAL entries below seq, which replay
@@ -418,16 +434,29 @@ class RecoveryManager:
                     return
                 seq, extra, cfg = job
                 try:
+                    from tuplewise_tpu.obs.tracing import maybe_span
+
                     if self._write_test_hook is not None:
                         self._write_test_hook(seq)
-                    write_snapshot(self.directory, seq=seq, extra=extra,
-                                   cfg=cfg)
+                    with maybe_span(self.tracer, "snapshot.write",
+                                    seq=seq):
+                        write_snapshot(self.directory, seq=seq,
+                                       extra=extra, cfg=cfg)
+                    if self.flight is not None:
+                        self.flight.record("snapshot_landed", seq=seq,
+                                           mode="async")
+                        # forensics freshness: the dump next to
+                        # snapshot.npz reflects at least this seal
+                        self.flight.auto_dump()
                     self._prune_segments(seq)
                 except BaseException as e:   # noqa: BLE001 — kept, not raised
                     # a failed write loses nothing: the sealed segments
                     # it would have pruned still replay over the OLD
                     # snapshot; record the error for stats()/operators
                     self.last_snapshot_error = repr(e)
+                    if self.flight is not None:
+                        self.flight.record("snapshot_error", seq=seq,
+                                           error=repr(e))
             finally:
                 with self._lock:
                     self._inflight = False

@@ -16,6 +16,10 @@ from typing import Optional
 
 import numpy as np
 
+from tuplewise_tpu.obs.report import (
+    recovery_counters, service_report,
+    stage_attribution as _stage_attr, stage_p99_ms as _stage_p99_ms,
+)
 from tuplewise_tpu.serving.engine import (
     BackpressureError, MicroBatchEngine, PoisonEventError, ServingConfig,
 )
@@ -35,6 +39,11 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
            score_every: int = 0, query_every: int = 0,
            chunk: int = 1, warmup: bool = False,
            max_inflight: Optional[int] = None, chaos=None,
+           tracer=None, trace_out: Optional[str] = None,
+           metrics_out: Optional[str] = None,
+           metrics_every_s: float = 1.0,
+           profile_dir: Optional[str] = None,
+           flight_out: Optional[str] = None,
            **overrides) -> dict:
     """Drive the engine with one request per event (or per ``chunk``
     events) and return the measurement record.
@@ -65,6 +74,15 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     counters, and the oracle-parity guardrail is computed over the
     ADMITTED events only. Warmup runs stay chaos-free (an injector is
     single-shot state).
+
+    Observability [ISSUE 6]: ``tracer`` (an ``obs.tracing.Tracer``) or
+    ``trace_out`` (a path — a tracer is created; ``*.jsonl`` exports
+    span JSONL, anything else Chrome trace JSON for perfetto) traces
+    the full request path; ``metrics_out``/``metrics_every_s`` stream
+    periodic registry snapshots via ``obs.MetricsFlusher``;
+    ``profile_dir`` brackets the timed window in a ``jax.profiler``
+    trace; ``flight_out`` dumps the engine's flight recorder after the
+    run. The warmup pass stays untraced (it measures nothing).
     """
     scores = np.asarray(scores, dtype=np.float64).ravel()
     labels = np.asarray(labels).ravel().astype(bool)
@@ -79,53 +97,77 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
         replay(scores, labels, config=cfg, score_every=score_every,
                query_every=query_every, chunk=chunk, warmup=False,
                max_inflight=max_inflight)
+    if tracer is None and trace_out:
+        from tuplewise_tpu.obs.tracing import Tracer
+
+        tracer = Tracer()
     rejected = 0
     poison_rejected = 0
     admitted = np.ones(n, dtype=bool)
     futures = []
-    with MicroBatchEngine(cfg, chaos=injector) as eng:
-        t0 = time.perf_counter()
-        for i in range(0, n, chunk):
-            j = min(i + chunk, n)
-            sub = scores[i:j]
-            if injector is not None:
-                sub, _ = injector.poison_batch(i, sub)
-            try:
-                futures.append(eng.insert(sub, labels[i:j]))
-            except PoisonEventError:
-                poison_rejected += j - i
-                admitted[i:j] = False
-            except BackpressureError:
-                rejected += j - i
-                admitted[i:j] = False
-            if max_inflight and len(futures) >= max_inflight:
+    flusher = None
+    with MicroBatchEngine(cfg, chaos=injector, tracer=tracer) as eng:
+        if metrics_out:
+            from tuplewise_tpu.obs.metrics_export import MetricsFlusher
+
+            flusher = MetricsFlusher(
+                eng.metrics, metrics_out, every_s=metrics_every_s,
+                meta={"stage": "replay"}, config=cfg).start()
+        from tuplewise_tpu.utils.profiling import trace as _jax_trace
+
+        with _jax_trace(profile_dir):
+            t0 = time.perf_counter()
+            for i in range(0, n, chunk):
+                j = min(i + chunk, n)
+                sub = scores[i:j]
+                if injector is not None:
+                    sub, _ = injector.poison_batch(i, sub)
                 try:
-                    futures[len(futures) - max_inflight].result(timeout=60.0)
+                    futures.append(eng.insert(sub, labels[i:j]))
+                except PoisonEventError:
+                    poison_rejected += j - i
+                    admitted[i:j] = False
                 except BackpressureError:
-                    pass    # counted in the final wait below
-            if score_every and (i // chunk) % score_every == score_every - 1:
+                    rejected += j - i
+                    admitted[i:j] = False
+                if max_inflight and len(futures) >= max_inflight:
+                    try:
+                        futures[len(futures) - max_inflight].result(
+                            timeout=60.0)
+                    except BackpressureError:
+                        pass    # counted in the final wait below
+                if score_every and (i // chunk) % score_every \
+                        == score_every - 1:
+                    try:
+                        futures.append(eng.score(scores[i:j]))
+                    except BackpressureError:
+                        pass
+                if query_every and (i // chunk) % query_every \
+                        == query_every - 1:
+                    try:
+                        futures.append(eng.query())
+                    except BackpressureError:
+                        pass
+            # wait for everything admitted (dropped futures raise)
+            dropped = 0
+            for f in futures:
                 try:
-                    futures.append(eng.score(scores[i:j]))
+                    f.result(timeout=60.0)
                 except BackpressureError:
-                    pass
-            if query_every and (i // chunk) % query_every == query_every - 1:
-                try:
-                    futures.append(eng.query())
-                except BackpressureError:
-                    pass
-        # wait for everything admitted (dropped futures raise)
-        dropped = 0
-        for f in futures:
-            try:
-                f.result(timeout=60.0)
-            except BackpressureError:
-                dropped += 1
-        wall = time.perf_counter() - t0
+                    dropped += 1
+            wall = time.perf_counter() - t0
         if eng.index is not None and cfg.bg_compact:
             # settle in-flight background builds OUTSIDE the timed
             # window so compaction/pause fields are deterministic
             eng.index.wait_idle()
+        if flusher is not None:
+            flusher.stop()
         stats = eng.stats()
+    # after close: the dump carries engine_closed + final-snapshot
+    # lifecycle events too
+    flight_counts = eng.flight.counts()
+    if flight_out:
+        eng.flight.dump_to(flight_out)
 
     lat = stats["metrics"]["request_latency_s"]
     ins = stats["metrics"].get("insert_latency_s", {})
@@ -173,6 +215,12 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
         "major_merge_p99_ms": _ms(major, "p99"),
         "batches": stats["metrics"]["batches_total"]["value"],
         "mean_batch_fill": fill["mean"],
+        # per-stage insert-latency attribution [ISSUE 6]: p99 per
+        # stage, plus the coverage check (stage sums vs measured sums
+        # — 1.0 up to float rounding by construction)
+        "insert_stage_p99_ms": _stage_p99_ms(stats["metrics"]),
+        "stage_attribution": _stage_attr(stats["metrics"]),
+        "flight_events": flight_counts,
         "auc_exact": stats.get("auc_exact"),
         "estimate_incomplete": stats["estimate_incomplete"],
         "incomplete_pairs": stats["metrics"]["incomplete_pairs_total"][
@@ -190,22 +238,25 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             "max_delta_runs": cfg.max_delta_runs,
         },
     }
+    # the shared report [ISSUE 6 satellite]: ONE builder feeds both
+    # this record and `tuplewise serve`'s exit summary, so the
+    # recovery/chaos counters can never drift between them again
+    rec["report"] = service_report(stats["metrics"])
+    if trace_out and tracer is not None:
+        if trace_out.endswith(".jsonl"):
+            tracer.export_jsonl(trace_out)
+        else:
+            tracer.export_chrome(trace_out)
+        rec["trace_out"] = trace_out
+        rec["trace_spans"] = len(tracer)
+    if metrics_out:
+        rec["metrics_out"] = metrics_out
     if injector is not None:
         # the recovery counters an operator greps for after a chaos
-        # run — the same numbers `tuplewise serve`'s exit summary and
-        # the CI chaos smoke assert on
-        def _c(name):
-            return stats["metrics"].get(name, {}).get("value", 0)
-
-        rec["faults"] = {
-            "reshard_events": _c("reshard_events"),
-            "shard_retries_total": _c("shard_retries_total"),
-            "bg_compactor_restarts": _c("bg_compactor_restarts"),
-            "batcher_restarts": _c("batcher_restarts"),
-            "poison_rejects": _c("poison_rejects"),
-            "deadline_expired_total": _c("deadline_expired_total"),
-            "chaos": injector.snapshot(),
-        }
+        # run — the same unified block `tuplewise serve`'s exit summary
+        # and the CI chaos smoke assert on [ISSUE 6 satellite]
+        rec["faults"] = dict(recovery_counters(stats["metrics"]),
+                             chaos=injector.snapshot())
         rec["n_admitted"] = int(admitted.sum())
         rec["shed_events"] = np.nonzero(~admitted)[0].tolist()
 

@@ -147,6 +147,21 @@ class FaultInjector:
         self._fired: Dict[str, int] = {}
         self._pending_dropped: Optional[Tuple[int, ...]] = None
         self.poisoned = 0
+        # observability [ISSUE 6 satellite]: when an engine attaches
+        # its flight recorder (and optionally a tracer), every fault
+        # that actually FIRES logs a correlated lifecycle event, so a
+        # post-mortem dump shows which latency spike was chaos
+        self._flight = None
+        self._tracer = None
+
+    def attach(self, flight=None, tracer=None) -> None:
+        """Attach the flight recorder / tracer that should witness
+        injections (called by the engine; idempotent — the most recent
+        attachment wins, matching the engine the injector drives)."""
+        if flight is not None:
+            self._flight = flight
+        if tracer is not None:
+            self._tracer = tracer
 
     # ------------------------------------------------------------------ #
     # construction                                                       #
@@ -217,6 +232,21 @@ class FaultInjector:
             delay = sum(f.seconds for f in due if f.action == "delay")
             errors = [f for f in due if f.action == "error"]
             kills = [f for f in due if f.action == "sigkill"]
+        if due and self._flight is not None:
+            # correlate with the trace active at the injection site
+            # (e.g. a compactor build's trace); a fault fired outside
+            # any span gets a fresh trace id so the dump still has a
+            # non-null correlation key
+            tid = None
+            if self._tracer is not None:
+                tid = self._tracer.current_trace_id()
+                if tid is None:
+                    tid = self._tracer.new_trace_id()
+            for f in due:
+                self._flight.record(
+                    "chaos_inject", trace_id=tid, point=point,
+                    action=f.action, on_call=f.on_call,
+                    dropped=list(f.dropped))
         if delay > 0:
             time.sleep(delay)
         if kills:
@@ -255,6 +285,10 @@ class FaultInjector:
         out[hit] = self.poison_value
         with self._lock:
             self.poisoned += len(hit)
+        if self._flight is not None:
+            self._flight.record(
+                "chaos_poison", n_poisoned=len(hit),
+                at_events=[start + i for i in hit])
         return out, len(hit)
 
     def snapshot(self) -> dict:

@@ -12,12 +12,18 @@ standardizes a few small tools:
                        a CLI flag straight through.
 * ``device_memory_stats()`` — per-device HBM usage snapshot where the
                        backend exposes it (TPU does; CPU returns {}).
-* ``Counter`` / ``Histogram`` / ``MetricsRegistry`` — the serving
-                       layer's service metrics (request counts, queue
-                       depth, batch fill, latency percentiles). Plain
-                       thread-safe host objects, no exporter dependency;
-                       ``snapshot()`` renders everything to one JSON-able
-                       dict for the CLI / replay reports.
+* ``Counter`` / ``Gauge`` / ``Histogram`` / ``MetricsRegistry`` — the
+                       serving layer's service metrics (request counts,
+                       queue depth, batch fill, latency percentiles).
+                       Plain thread-safe host objects, no exporter
+                       dependency; ``snapshot()`` renders everything to
+                       one JSON-able dict for the CLI / replay reports
+                       and the ``obs.MetricsFlusher`` JSONL stream.
+                       Metrics accept optional ``labels`` [ISSUE 6]: a
+                       small immutable tag dict rendered into the
+                       registry key (``name{k=v}``) and carried in the
+                       snapshot, so per-shard / per-tenant series stay
+                       distinct without a label-indexed store.
 """
 
 from __future__ import annotations
@@ -69,6 +75,15 @@ def annotate(name: str) -> Iterator[None]:
 # service metrics (serving layer)                                        #
 # --------------------------------------------------------------------- #
 
+def labeled_name(name: str, labels: Optional[dict]) -> str:
+    """Registry key for a (name, labels) pair: ``name{k=v,k2=v2}`` with
+    keys sorted — one canonical key per label set."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
 class Counter:
     """Monotonic counter: ``c.inc()`` / ``c.inc(5)``; ``c.value``.
 
@@ -76,9 +91,11 @@ class Counter:
     while request threads read snapshots.
     """
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[dict] = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else None
         self._lock = threading.Lock()
         self._value = 0
 
@@ -94,7 +111,47 @@ class Counter:
             return self._value
 
     def snapshot(self) -> dict:
-        return {"type": "counter", "value": self.value}
+        out = {"type": "counter", "value": self.value}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
+
+
+class Gauge:
+    """Point-in-time value: ``g.set(v)`` / ``g.add(dv)``; ``g.value``.
+
+    The live-state complement of Counter [ISSUE 6]: queue depth,
+    inflight requests, delta-run size, tombstone occupancy, mesh width
+    — values that go DOWN as well as up, where the current reading (not
+    the total) is the signal. Thread-safe.
+    """
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[dict] = None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels) if labels else None
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self._value += float(delta)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        out = {"type": "gauge", "value": self.value}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 # Default buckets span the serving latency range: 10 us .. ~100 s.
@@ -121,9 +178,11 @@ class Histogram:
 
     def __init__(self, name: str, help: str = "",
                  buckets: Optional[Sequence[float]] = None,
-                 max_samples: int = 65536):
+                 max_samples: int = 65536,
+                 labels: Optional[dict] = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else None
         self.buckets: List[float] = sorted(buckets or _DEFAULT_BUCKETS)
         if max_samples < 1:
             raise ValueError("max_samples must be >= 1")
@@ -138,18 +197,32 @@ class Histogram:
         self._ring_pos = 0
 
     def observe(self, value: float) -> None:
+        self.observe_n(value, 1)
+
+    def observe_n(self, value: float, n: int) -> None:
+        """Record ``value`` with multiplicity ``n`` under ONE lock
+        acquisition — the insert-latency stage attribution [ISSUE 6]
+        bills a shared per-batch stage duration to every request in the
+        batch without n separate observe calls on the hot batcher
+        thread. Quantiles and sums weigh the value n times, exactly as
+        n ``observe`` calls would."""
+        if n < 1:
+            if n == 0:
+                return
+            raise ValueError(f"Histogram {self.name}: negative n {n}")
         value = float(value)
         with self._lock:
-            self._bucket_counts[bisect.bisect_left(self.buckets, value)] += 1
-            self._count += 1
-            self._sum += value
+            self._bucket_counts[bisect.bisect_left(self.buckets, value)] += n
+            self._count += n
+            self._sum += value * n
             self._min = value if self._min is None else min(self._min, value)
             self._max = value if self._max is None else max(self._max, value)
-            if len(self._samples) < self._max_samples:
-                self._samples.append(value)
-            else:
-                self._samples[self._ring_pos] = value
-                self._ring_pos = (self._ring_pos + 1) % self._max_samples
+            for _ in range(min(n, self._max_samples)):
+                if len(self._samples) < self._max_samples:
+                    self._samples.append(value)
+                else:
+                    self._samples[self._ring_pos] = value
+                    self._ring_pos = (self._ring_pos + 1) % self._max_samples
 
     @property
     def count(self) -> int:
@@ -190,6 +263,7 @@ class Histogram:
             "min": vmin,
             "max": vmax,
             "mean": total / count if count else None,
+            **({"labels": dict(self.labels)} if self.labels else {}),
             "buckets": {
                 ("+inf" if i == len(self.buckets) else repr(self.buckets[i])):
                     c
@@ -215,33 +289,41 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name, Counter, help)
+    def counter(self, name: str, help: str = "",
+                labels: Optional[dict] = None) -> Counter:
+        return self._get(name, Counter, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[dict] = None) -> Gauge:
+        return self._get(name, Gauge, help, labels)
 
     def histogram(self, name: str, help: str = "",
                   buckets: Optional[Sequence[float]] = None,
-                  max_samples: int = 65536) -> Histogram:
+                  max_samples: int = 65536,
+                  labels: Optional[dict] = None) -> Histogram:
+        key = labeled_name(name, labels)
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
                 m = Histogram(name, help, buckets=buckets,
-                              max_samples=max_samples)
-                self._metrics[name] = m
+                              max_samples=max_samples, labels=labels)
+                self._metrics[key] = m
             elif not isinstance(m, Histogram):
                 raise TypeError(
-                    f"metric {name!r} already registered as "
+                    f"metric {key!r} already registered as "
                     f"{type(m).__name__}")
             return m
 
-    def _get(self, name, cls, help):
+    def _get(self, name, cls, help, labels=None):
+        key = labeled_name(name, labels)
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = cls(name, help)
-                self._metrics[name] = m
+                m = cls(name, help, labels=labels)
+                self._metrics[key] = m
             elif not isinstance(m, cls):
                 raise TypeError(
-                    f"metric {name!r} already registered as "
+                    f"metric {key!r} already registered as "
                     f"{type(m).__name__}")
             return m
 
