@@ -459,13 +459,19 @@ def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
 
 
 def _streaming_main(args):
+    import uuid
+
     chaos = None
     if args.chaos:
         from tuplewise_tpu.testing.chaos import FaultInjector
 
         chaos = FaultInjector.from_spec(
             args.chaos_spec or _CHAOS_BENCH_SPEC)
-    obs = {}
+    # run identity [ISSUE 7 satellite]: one id per bench invocation,
+    # stamped (with the config digest replay adds) into every JSONL
+    # row this run appends — scripts/perf_gate.py joins history on it
+    run_id = uuid.uuid4().hex[:12]
+    obs = {"run_id": run_id}
     if args.trace_out:
         obs["trace_out"] = args.trace_out
     if args.metrics_out:
@@ -473,6 +479,8 @@ def _streaming_main(args):
         obs["metrics_every_s"] = args.metrics_every
     if args.profile_dir:
         obs["profile_dir"] = args.profile_dir
+    if args.slo_spec:
+        obs["slo_spec"] = args.slo_spec
     rec, base, sync = _streaming_events_per_sec(
         n_events=args.n_events, budget=args.budget,
         max_batch=args.max_batch, window=args.window,
@@ -484,6 +492,8 @@ def _streaming_main(args):
         "metric": "events/sec",
         "value": round(rec["events_per_s"], 1),
         "unit": "events/s",
+        "run_id": run_id,
+        "config_digest": rec.get("config_digest"),
         "vs_baseline": round(rec["events_per_s"] / base["events_per_s"], 2),
         "vs_baseline_note": (
             "same request path with the dynamic batcher disabled "
@@ -514,6 +524,10 @@ def _streaming_main(args):
         # the (admitted-events) oracle parity in the same record
         out["faults"] = rec.get("faults")
         out["events_poison_rejected"] = rec.get("events_poison_rejected")
+    if rec.get("slo") is not None:
+        # live SLO verdicts [ISSUE 7]: the bench run judged by the
+        # same objectives a serve deploy would carry
+        out["slo"] = rec["slo"]
     if sync is not None:
         out["sync_compact_insert_p99_ms"] = sync["insert_latency_p99_ms"]
         out["sync_compact_pause_p99_ms"] = sync["compaction_pause_p99_ms"]
@@ -539,7 +553,7 @@ def _streaming_main(args):
         rows = [dict(out, stage="bench_streaming")]
         if out.get("delta_compaction"):
             rows.append(dict(out["delta_compaction"],
-                             stage="delta_compaction"))
+                             stage="delta_compaction", run_id=run_id))
         with open(args.out, "a", encoding="utf-8") as f:
             for r in rows:
                 f.write(json.dumps(r) + "\n")
@@ -585,6 +599,11 @@ def main():
     ap.add_argument("--chaos-spec", type=str, default=None,
                     help="override the default --chaos schedule (JSON "
                          "inline, @file, or *.json path)")
+    ap.add_argument("--slo-spec", type=str, default=None,
+                    help="with --streaming: evaluate these SLO "
+                         "objectives (obs.slo spec: JSON inline, "
+                         "@file, or *.json) live during the main run; "
+                         "verdicts land in the record's 'slo' block")
     ap.add_argument("--trace-out", type=str, default=None,
                     help="with --streaming: export the span trace of "
                          "the main timed run (*.jsonl = span JSONL, "

@@ -68,4 +68,40 @@ if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 # results/ for the CI artifact.
 timeout -k 10 240 env JAX_PLATFORMS=cpu \
     python scripts/obs_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Doctor [ISSUE 7]: post-hoc diagnosis over the obs_smoke artifacts.
+# The chaos run must diagnose as non-degraded (every injected fault
+# correlated with recovery evidence => verdict "recovered", exit 0;
+# "degraded" exits 2) and the last stdout line must be one
+# machine-parseable JSON verdict; report + verdict land under
+# results/ for the CI artifact.
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m tuplewise_tpu.harness.cli doctor \
+    --metrics results/metrics.jsonl \
+    --flight results/obs_flight.jsonl \
+    --spans results/obs_spans.jsonl \
+    --quiet --out results/doctor_report.json \
+    | tee results/doctor_verdict.jsonl
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+python - <<'PYEOF'
+import json
+line = open("results/doctor_verdict.jsonl").read().strip().splitlines()[-1]
+v = json.loads(line)
+assert v["healthy"], v
+assert v["faults"] == v["faults_resolved"] >= 4, v
+print("doctor verdict OK:", v)
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Perf gate [ISSUE 7]: the newest bench_streaming row in the committed
+# results/serving.jsonl vs its history, with noise bands. Warn-then-
+# fail rollout: currently --mode warn (always exit 0, breaches printed
+# + archived in results/perf_gate.jsonl); flip to --mode fail once the
+# bands have soaked against real runner noise.
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python scripts/perf_gate.py --mode warn
 exit $?

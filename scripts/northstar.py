@@ -435,6 +435,12 @@ def main():
         nS = 2_000 if q else 300_000
         log(f"== stage serve (replay, n_events={nS}) ==")
         sS, lS = make_stream(nS, pos_frac=0.5, separation=1.0, seed=0)
+        # run identity [ISSUE 7 satellite]: one id per northstar
+        # invocation (replay stamps the config digest per cell), so
+        # scripts/perf_gate.py can join history without guessing
+        import uuid
+
+        run_id = uuid.uuid4().hex[:12]
         path = _out("serving.jsonl")
         if os.path.exists(path):
             os.remove(path)
@@ -473,7 +479,7 @@ def main():
             # length-stable); a shorter stream bounds its wall time
             nCell = min(nS, 50_000) if cell.get("max_batch") == 1 else nS
             rec = replay(sS[:nCell], lS[:nCell], config=cfg, warmup=not q,
-                         max_inflight=64)
+                         max_inflight=64, run_id=run_id)
             rec["stage"] = "serve"
             rec["max_inflight"] = 64
             write_jsonl([rec], path)

@@ -36,6 +36,26 @@ CHAOS = {"faults": [
     {"point": "poison", "at_events": [150, 900], "value": "nan"},
 ]}
 
+# live SLO objectives [ISSUE 7]: generous bounds a healthy CPU smoke
+# always clears — the smoke asserts the EVALUATION ran (gauges + a
+# healthy verdict), the breach path is pinned by tests/test_slo.py
+SLO = {"objectives": [
+    {"name": "insert_p99", "type": "latency",
+     "metric": "insert_latency_s", "quantile": "p99",
+     "threshold_ms": 2000.0},
+    {"name": "availability", "type": "error_rate",
+     "errors": ["rejected_total", "dropped_total",
+                "deadline_expired_total"],
+     "total": "requests_insert_total", "objective": 0.99,
+     "windows": [{"window_s": 0.5, "burn": 20.0},
+                 {"window_s": 2.0, "burn": 5.0}]},
+    {"name": "no_heal_exhaustion", "type": "counter_max",
+     "metric": "heal_exhausted_total", "max": 0},
+    {"name": "queue_saturation", "type": "saturation",
+     "metric": "queue_depth_live", "capacity": "queue_size",
+     "max_fraction": 0.99},
+]}
+
 
 def _fail(msg: str) -> int:
     print(f"OBS SMOKE FAIL: {msg}", file=sys.stderr)
@@ -121,6 +141,36 @@ def _check_metrics(path: str) -> int:
     return 0
 
 
+def _check_slo(rec: dict, metrics_path: str) -> int:
+    """Live SLO evaluation [ISSUE 7]: the verdict block exists, every
+    objective was judged, nothing breached (the bounds are generous),
+    and the slo_* gauges landed in the metrics stream itself."""
+    slo = rec.get("slo")
+    if not slo:
+        return _fail("record has no slo block despite slo_spec")
+    if set(slo["objectives"]) != {o["name"] for o in SLO["objectives"]}:
+        return _fail(f"slo objectives mismatch: {sorted(slo['objectives'])}")
+    if slo["evaluations"] < 2:
+        return _fail(f"slo evaluated only {slo['evaluations']} times")
+    if not slo["healthy"]:
+        return _fail(f"healthy smoke breached SLOs: {slo['objectives']}")
+    with open(metrics_path, "r", encoding="utf-8") as f:
+        last = None
+        for line in f:
+            if line.strip():
+                last = line
+    m = json.loads(last)["metrics"]
+    gauges = [k for k in m if k.startswith("slo_breached{")]
+    if len(gauges) != len(SLO["objectives"]):
+        return _fail(f"expected {len(SLO['objectives'])} slo_breached "
+                     f"gauges in metrics.jsonl, found {gauges}")
+    if any(m[g]["value"] != 0.0 for g in gauges):
+        return _fail("slo_breached gauge stuck nonzero on healthy run")
+    print(f"  slo OK: {len(slo['objectives'])} objectives x "
+          f"{slo['evaluations']} evaluations, healthy", file=sys.stderr)
+    return 0
+
+
 def _check_flight(path: str, rec: dict) -> int:
     from tuplewise_tpu.obs.flight import FlightRecorder
 
@@ -191,7 +241,7 @@ def main(argv=None) -> int:
     rec = replay(scores, labels, config=cfg, max_inflight=256,
                  chaos=CHAOS, tracer=tracer, trace_out=trace_json,
                  metrics_out=metrics_out, metrics_every_s=0.2,
-                 flight_out=flight_out)
+                 flight_out=flight_out, slo_spec=SLO)
     tracer.export_jsonl(spans_jsonl)
     if tracer.dropped:
         return _fail(f"tracer ring dropped {tracer.dropped} spans — "
@@ -200,7 +250,8 @@ def main(argv=None) -> int:
     rc = (_check_chrome(trace_json)
           or _check_stage_sums(spans_jsonl)
           or _check_metrics(metrics_out)
-          or _check_flight(flight_out, rec))
+          or _check_flight(flight_out, rec)
+          or _check_slo(rec, metrics_out))
     if rc:
         return rc
 
@@ -221,6 +272,8 @@ def main(argv=None) -> int:
         "trace_spans": rec["trace_spans"],
         "flight_events": rec["flight_events"],
         "auc_abs_err": rec.get("auc_abs_err"),
+        "slo_healthy": rec["slo"]["healthy"],
+        "slo_evaluations": rec["slo"]["evaluations"],
     }
     with open(args.out, "w", encoding="utf-8") as f:
         f.write(json.dumps(row) + "\n")

@@ -1,0 +1,209 @@
+"""Perf-regression sentinel over the serving trajectory [ISSUE 7
+tentpole].
+
+``results/serving.jsonl`` is append-only round-over-round bookkeeping:
+every PR's ``bench.py --streaming`` lands a ``bench_streaming`` row,
+and until now NOTHING read them back — a 30% throughput regression
+would merge silently as one more row. This gate compares the NEWEST
+row against the history of comparable rows with noise bands:
+
+    center = median(history)
+    band   = max(tolerance_frac * center, mad_k * 1.4826 * MAD)
+
+(the MAD term widens the band when the history itself is noisy — CPU
+CI runners are — while ``tolerance_frac`` keeps a floor so two
+identical historic rows don't produce a zero-width band). A breach is
+
+    events_per_s          below  center - band      (throughput), or
+    insert_latency_p99_ms above  center + band      (tail latency).
+
+Rows are joined on the ``config_digest`` stamped by ``bench.py``
+[ISSUE 7 satellite]; legacy rows without a digest join on the config
+fields that determine comparability (n_events / bg_compact /
+max_inflight / budget / max_batch), so pre-digest history still
+counts.
+
+Modes (the warn-then-fail CI rollout):
+
+* ``--mode warn`` — report breaches, always exit 0 (current ci.sh leg)
+* ``--mode fail`` — exit 1 on breach (flip the leg once the band has
+  soaked against real runner noise)
+
+Always writes the verdict row (stage ``perf_gate``) to ``--out`` for
+the CI artifact, and prints it as one stdout JSON line.
+
+Usage: python scripts/perf_gate.py [--history results/serving.jsonl]
+                                   [--mode warn|fail]
+                                   [--out results/perf_gate.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from statistics import median
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metric -> direction ("min" = lower is better)
+_GATED = (("events_per_s", "max", "value"),
+          ("insert_latency_p99_ms", "min", "insert_latency_p99_ms"))
+
+# the config fields that make two bench_streaming rows comparable when
+# no config_digest is stamped (pre-ISSUE-7 history)
+_LEGACY_KEY = ("n_events", "bg_compact", "max_inflight", "max_batch")
+
+
+def load_rows(path: str, stage: str):
+    rows = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("stage") == stage:
+                rows.append(row)
+    return rows
+
+
+def _legacy_key(row: dict):
+    return tuple(row.get(k) for k in _LEGACY_KEY)
+
+
+def comparable_history(rows, newest):
+    """History rows comparable to the newest one: same config_digest
+    when both sides carry one, else same legacy config fields."""
+    digest = newest.get("config_digest")
+    out = []
+    for r in rows[:-1]:
+        if digest and r.get("config_digest"):
+            if r["config_digest"] == digest:
+                out.append(r)
+        elif _legacy_key(r) == _legacy_key(newest):
+            out.append(r)
+    return out
+
+
+def _value(row: dict, metric: str, value_field: str):
+    # events_per_s lives under "value" in bench rows (metric field
+    # says events/sec); p99 is a first-class field
+    if metric == "events_per_s":
+        v = row.get("value")
+        if v is None:
+            v = row.get("events_per_s")
+        return v
+    return row.get(value_field)
+
+
+def _mad(xs, center):
+    return median([abs(x - center) for x in xs])
+
+
+def gate(rows, tolerance_frac: float, mad_k: float,
+         min_history: int) -> dict:
+    newest = rows[-1]
+    hist = comparable_history(rows, newest)
+    verdict = {
+        "stage": "perf_gate",
+        "run_id": newest.get("run_id"),
+        "config_digest": newest.get("config_digest"),
+        "n_history": len(hist),
+        "min_history": min_history,
+        "tolerance_frac": tolerance_frac,
+        "mad_k": mad_k,
+        "checks": [],
+        "ok": True,
+    }
+    if len(hist) < min_history:
+        verdict["note"] = (
+            f"insufficient comparable history ({len(hist)} < "
+            f"{min_history}) — gate passes vacuously")
+        return verdict
+    for metric, direction, field in _GATED:
+        new = _value(newest, metric, field)
+        xs = [v for v in (_value(r, metric, field) for r in hist)
+              if v is not None]
+        if new is None or len(xs) < min_history:
+            verdict["checks"].append({
+                "metric": metric, "ok": True,
+                "note": "metric missing from newest row or history"})
+            continue
+        center = median(xs)
+        band = max(tolerance_frac * abs(center),
+                   mad_k * 1.4826 * _mad(xs, center))
+        if direction == "max":
+            breach = (center - new) > band
+            limit = center - band
+        else:
+            breach = (new - center) > band
+            limit = center + band
+        verdict["checks"].append({
+            "metric": metric, "direction": direction, "new": new,
+            "median": center, "band": band, "limit": limit,
+            "n": len(xs), "ok": not breach,
+        })
+        if breach:
+            verdict["ok"] = False
+    return verdict
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--history", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "serving.jsonl"))
+    ap.add_argument("--stage", type=str, default="bench_streaming")
+    ap.add_argument("--mode", choices=["warn", "fail"], default="warn")
+    ap.add_argument("--min-history", type=int, default=2)
+    ap.add_argument("--tolerance-frac", type=float, default=0.15,
+                    help="relative band floor (0.15 = 15%% of the "
+                         "history median)")
+    ap.add_argument("--mad-k", type=float, default=4.0,
+                    help="band widens to k robust-sigmas (1.4826*MAD) "
+                         "when the history itself is noisy")
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "perf_gate.jsonl"))
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.history):
+        print(f"PERF GATE: no history file {args.history!r} — "
+              "nothing to gate", file=sys.stderr)
+        return 0
+    rows = load_rows(args.history, args.stage)
+    if not rows:
+        print(f"PERF GATE: no {args.stage!r} rows in {args.history!r}",
+              file=sys.stderr)
+        return 0
+
+    verdict = gate(rows, args.tolerance_frac, args.mad_k,
+                   args.min_history)
+    verdict["mode"] = args.mode
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(json.dumps(verdict) + "\n")
+    print(json.dumps(verdict))
+    if not verdict["ok"]:
+        bad = [c["metric"] for c in verdict["checks"] if not c["ok"]]
+        msg = (f"PERF GATE {'FAIL' if args.mode == 'fail' else 'WARN'}:"
+               f" regression in {bad} vs {verdict['n_history']}-row "
+               f"history (bands in {args.out})")
+        print(msg, file=sys.stderr)
+        if args.mode == "fail":
+            return 1
+    else:
+        print(f"PERF GATE OK: {len(verdict['checks'])} checks vs "
+              f"{verdict['n_history']} comparable rows",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

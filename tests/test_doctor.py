@@ -1,0 +1,249 @@
+"""tuplewise doctor [ISSUE 7]: post-hoc diagnosis over a run's
+artifacts — fault correlation (every injected fault exactly once),
+verdict taxonomy, machine-readable verdict line, CLI contract."""
+
+import json
+import os
+
+import pytest
+
+from tuplewise_tpu.obs.doctor import (
+    correlate_faults, diagnose, load_metrics_rows, top_self_spans,
+)
+
+CHAOS = {"faults": [
+    {"point": "compactor_build", "on_call": 1, "action": "error"},
+    # on_call low enough to fire within the first few batch-loop
+    # iterations at test scale (obs_smoke runs the bigger schedule)
+    {"point": "batcher", "on_call": 3, "action": "error"},
+    {"point": "poison", "at_events": [150, 900], "value": "nan"},
+]}
+
+
+@pytest.fixture(scope="module")
+def chaos_run(tmp_path_factory):
+    """One chaos-injected replay, artifacts on disk — the obs_smoke
+    schedule at test scale."""
+    d = str(tmp_path_factory.mktemp("chaos_run"))
+    from tuplewise_tpu.obs.tracing import Tracer
+    from tuplewise_tpu.serving import ServingConfig
+    from tuplewise_tpu.serving.replay import make_stream, replay
+
+    scores, labels = make_stream(3000, pos_frac=0.5, separation=1.0,
+                                 seed=0)
+    cfg = ServingConfig(policy="block", compact_every=256,
+                        bg_compact=True)
+    tracer = Tracer(capacity=1 << 16)
+    rec = replay(scores, labels, config=cfg, max_inflight=256,
+                 chaos=CHAOS, tracer=tracer,
+                 metrics_out=os.path.join(d, "metrics.jsonl"),
+                 metrics_every_s=0.1,
+                 flight_out=os.path.join(d, "flight.jsonl"))
+    tracer.export_jsonl(os.path.join(d, "spans.jsonl"))
+    return d, rec
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("clean_run"))
+    from tuplewise_tpu.serving import ServingConfig
+    from tuplewise_tpu.serving.replay import make_stream, replay
+
+    scores, labels = make_stream(1200, seed=1)
+    cfg = ServingConfig(policy="block", compact_every=512)
+    replay(scores, labels, config=cfg, max_inflight=128,
+           metrics_out=os.path.join(d, "metrics.jsonl"),
+           metrics_every_s=0.1,
+           flight_out=os.path.join(d, "flight.jsonl"))
+    return d
+
+
+class TestChaosDiagnosis:
+    def test_every_injected_fault_exactly_once_correlated(self,
+                                                          chaos_run):
+        d, _ = chaos_run
+        rep = diagnose(run_dir=d)
+        faults = rep["faults"]
+        # the schedule injects 2 faults + 2 poison events -> 4 entries
+        assert len(faults) == 4
+        by_point = {}
+        for f in faults:
+            by_point.setdefault(f["point"], []).append(f)
+        assert sorted(by_point) == ["batcher", "compactor_build",
+                                    "poison"]
+        assert len(by_point["poison"]) == 2
+        assert {f["at_event"] for f in by_point["poison"]} == {150, 900}
+        # every fault resolved, with named recovery evidence
+        for f in faults:
+            assert f["resolved"], f
+        assert by_point["batcher"][0]["resolution"] == "batcher_restart"
+        assert by_point["compactor_build"][0]["resolution"] in (
+            "compaction_resumed", "compactor_restarted")
+        # trace correlation: the compactor fault's trace id resolves to
+        # the build span that died
+        assert by_point["compactor_build"][0]["trace_span"] == \
+            "compactor.build"
+        for f in by_point["poison"]:
+            assert f["resolution"] == "poison_rejected"
+
+    def test_verdict_recovered_and_machine_line(self, chaos_run):
+        d, _ = chaos_run
+        rep = diagnose(run_dir=d)
+        assert rep["verdict"] == "recovered"
+        line = rep["verdict_line"]
+        assert line["healthy"] is True
+        assert line["doctor_verdict"] == "recovered"
+        assert line["faults"] == line["faults_resolved"] == 4
+        json.dumps(line)    # machine-parseable by construction
+
+    def test_report_carries_slo_health_spans_counters(self, chaos_run):
+        d, _ = chaos_run
+        rep = diagnose(run_dir=d)
+        assert rep["slo"] is not None and rep["slo"]["healthy"]
+        assert rep["health"]["estimate_ci_width"] is not None
+        assert rep["top_self_spans"], "span export not digested"
+        names = {s["name"] for s in rep["top_self_spans"]}
+        assert any(n.startswith("insert.") for n in names)
+        assert "recovery_counters" in rep
+        assert rep["run"]["events_total"] > 0
+        assert rep["run"]["config_digest"]
+
+    def test_explicit_paths_override_dir_probe(self, chaos_run):
+        d, _ = chaos_run
+        rep = diagnose(metrics_path=os.path.join(d, "metrics.jsonl"),
+                       flight_path=os.path.join(d, "flight.jsonl"))
+        assert rep["verdict"] == "recovered"
+        # no spans given: correlation still works, span name is None
+        assert rep["top_self_spans"] == []
+
+
+class TestCleanDiagnosis:
+    def test_clean_run_is_healthy(self, clean_run):
+        rep = diagnose(run_dir=clean_run)
+        assert rep["verdict"] == "healthy"
+        assert rep["faults"] == []
+        assert rep["verdict_line"]["healthy"] is True
+
+
+class TestDegradedPaths:
+    def _artifacts(self, tmp_path, flight_events, metrics_rows=None):
+        fdump = tmp_path / "flight.jsonl"
+        with open(fdump, "w") as f:
+            f.write(json.dumps({"format": "tuplewise-flight-v1",
+                                "n_events": len(flight_events),
+                                "dropped": 0}) + "\n")
+            for e in flight_events:
+                f.write(json.dumps(e) + "\n")
+        if metrics_rows is not None:
+            mpath = tmp_path / "metrics.jsonl"
+            with open(mpath, "w") as f:
+                for r in metrics_rows:
+                    f.write(json.dumps(r) + "\n")
+        return str(tmp_path)
+
+    def test_unresolved_fault_degrades(self, tmp_path):
+        d = self._artifacts(tmp_path, [
+            {"kind": "chaos_inject", "seq": 1, "t_wall": 0.0,
+             "t_mono": 0.0, "trace_id": 7, "point": "batcher",
+             "action": "error", "on_call": 1}])
+        rep = diagnose(run_dir=d)
+        assert rep["verdict"].startswith("degraded")
+        assert "unresolved" in rep["verdict"]
+        assert rep["verdict_line"]["healthy"] is False
+
+    def test_heal_exhaustion_degrades(self, tmp_path):
+        d = self._artifacts(tmp_path, [
+            {"kind": "heal_exhausted", "seq": 1, "t_wall": 0.0,
+             "t_mono": 0.0, "trace_id": None, "error": "x"}])
+        rep = diagnose(run_dir=d)
+        assert "heal_exhausted" in rep["verdict"]
+
+    def test_slo_breach_in_history_degrades(self, tmp_path):
+        rows = [{"seq": i + 1, "ts_wall": float(i), "ts_mono": float(i),
+                 "platform": "cpu", "config_digest": "d",
+                 "metrics": {
+                     "requests_insert_total":
+                         {"type": "counter", "value": 100 * (i + 1)},
+                     "rejected_total":
+                         {"type": "counter", "value": 60 * (i + 1)},
+                 }} for i in range(12)]
+        d = self._artifacts(tmp_path, [], metrics_rows=rows)
+        rep = diagnose(run_dir=d)
+        assert "slo_breached" in rep["verdict"]
+        assert rep["verdict_line"]["slo_breaches"] > 0
+
+    def test_torn_metrics_tail_tolerated(self, tmp_path):
+        mpath = tmp_path / "metrics.jsonl"
+        row = {"seq": 1, "ts_wall": 0.0, "ts_mono": 0.0,
+               "metrics": {}}
+        with open(mpath, "w") as f:
+            f.write(json.dumps(row) + "\n")
+            f.write('{"seq": 2, "ts_wall": 0.1, "truncat')
+        assert load_metrics_rows(str(mpath)) == [row]
+
+    def test_no_artifacts_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            diagnose(run_dir=str(tmp_path))
+
+
+class TestUnits:
+    def test_top_self_spans_subtracts_children(self):
+        spans = [
+            {"trace_id": 1, "span_id": 1, "parent_id": None,
+             "name": "root", "t0_s": 0.0, "dur_s": 1.0},
+            {"trace_id": 1, "span_id": 2, "parent_id": 1,
+             "name": "child", "t0_s": 0.1, "dur_s": 0.7},
+        ]
+        top = top_self_spans(spans, 5)
+        by = {s["name"]: s for s in top}
+        assert by["child"]["self_s"] == pytest.approx(0.7)
+        assert by["root"]["self_s"] == pytest.approx(0.3)
+        assert top[0]["name"] == "child"
+
+    def test_correlate_ignores_unknown_points_gracefully(self):
+        evs = [{"kind": "chaos_inject", "seq": 1, "t_wall": 0.0,
+                "point": "train_step", "action": "error",
+                "trace_id": None},
+               {"kind": "heal", "seq": 2, "t_wall": 0.1,
+                "trace_id": None, "mesh_width": 2}]
+        faults = correlate_faults(evs, [], [])
+        assert len(faults) == 1
+        assert faults[0]["resolved"] and faults[0]["resolution"] == \
+            "healed"
+
+
+class TestCli:
+    def test_doctor_cli_last_line_is_machine_verdict(self, chaos_run,
+                                                     tmp_path,
+                                                     capsys):
+        d, _ = chaos_run
+        from tuplewise_tpu.harness.cli import main
+
+        out_path = str(tmp_path / "report.json")
+        rc = main(["doctor", "--dir", d, "--out", out_path])
+        assert rc == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        line = json.loads(lines[-1])
+        assert line["doctor_verdict"] == "recovered"
+        assert line["healthy"] is True
+        with open(out_path) as f:
+            full = json.load(f)
+        assert full["verdict"] == "recovered"
+
+    def test_doctor_cli_quiet_and_degraded_exit(self, tmp_path,
+                                                capsys):
+        fdump = tmp_path / "flight.jsonl"
+        with open(fdump, "w") as f:
+            f.write(json.dumps({"format": "tuplewise-flight-v1",
+                                "n_events": 1, "dropped": 0}) + "\n")
+            f.write(json.dumps(
+                {"kind": "chaos_inject", "seq": 1, "t_wall": 0.0,
+                 "point": "batcher", "action": "error",
+                 "trace_id": 1}) + "\n")
+        from tuplewise_tpu.harness.cli import main
+
+        rc = main(["doctor", "--flight", str(fdump), "--quiet"])
+        assert rc == 2
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 1    # quiet: only the machine verdict
+        assert json.loads(lines[0])["healthy"] is False

@@ -1,0 +1,247 @@
+"""obs.health [ISSUE 7]: CI-width monitor vs offline NumPy, drift
+detection, shard balance, and the engine/index integration."""
+
+import math
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.health import (
+    DriftDetector, EstimateHealth, shard_balance,
+)
+from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+
+class TestEstimateHealth:
+    def test_matches_offline_numpy_recomputation(self):
+        rng = np.random.default_rng(0)
+        h = EstimateHealth(retain_terms=True)
+        all_terms = []
+        for _ in range(40):
+            batch = rng.choice([0.0, 0.5, 1.0],
+                               size=rng.integers(1, 400),
+                               p=[0.2, 0.1, 0.7])
+            h.update(batch)
+            all_terms.append(batch)
+        terms = np.concatenate(all_terms)
+        assert h.n == terms.size
+        assert h.mean == pytest.approx(float(terms.mean()), abs=1e-12)
+        assert h.variance() == pytest.approx(
+            float(np.var(terms, ddof=1)), rel=1e-10)
+        se = math.sqrt(np.var(terms, ddof=1) / terms.size)
+        assert h.std_error() == pytest.approx(se, rel=1e-10)
+        assert h.ci_width() == pytest.approx(2 * 1.959963984540054 * se,
+                                             rel=1e-10)
+        chk = h.offline_check()
+        assert chk["abs_err"]["variance"] < 1e-12
+        assert chk["abs_err"]["ci_width"] < 1e-12
+
+    def test_ci_width_shrinks_with_n(self):
+        rng = np.random.default_rng(1)
+        h = EstimateHealth()
+        h.update(rng.random(100))
+        w1 = h.ci_width()
+        for _ in range(99):
+            h.update(rng.random(100))
+        assert h.ci_width() < w1 / 5     # ~ sqrt(100) shrink
+
+    def test_batch_ci_honors_batch_structure(self):
+        h = EstimateHealth()
+        # identical batch means -> zero batch-mean variance even
+        # though within-batch variance is large
+        for _ in range(10):
+            h.update(np.array([0.0, 1.0]))
+        assert h.variance() > 0
+        assert h.batch_std_error() == pytest.approx(0.0, abs=1e-15)
+
+    def test_undefined_below_two_terms(self):
+        h = EstimateHealth()
+        assert h.variance() is None and h.ci_width() is None
+        h.update(np.array([0.5]))
+        assert h.variance() is None
+        h.update(np.array([], dtype=float))
+        assert h.n == 1
+
+    def test_gauges_exported(self):
+        reg = MetricsRegistry()
+        h = EstimateHealth(metrics=reg)
+        h.update(np.array([0.0, 0.5, 1.0, 1.0]))
+        snap = reg.snapshot()
+        assert snap["estimate_terms"]["value"] == 4
+        assert snap["estimate_ci_width"]["value"] == \
+            pytest.approx(h.ci_width())
+
+    def test_offline_check_requires_retention(self):
+        with pytest.raises(RuntimeError):
+            EstimateHealth().offline_check()
+
+    def test_confidence_validation(self):
+        with pytest.raises(ValueError):
+            EstimateHealth(confidence=1.5)
+
+
+class TestStreamingIntegration:
+    def test_streaming_terms_feed_monitor_and_match_offline(self):
+        from tuplewise_tpu.serving.streaming import StreamingIncompleteU
+
+        h = EstimateHealth(retain_terms=True)
+        s = StreamingIncompleteU(budget=16, reservoir=256, seed=0,
+                                 health=h)
+        rng = np.random.default_rng(2)
+        for i in range(30):
+            n = int(rng.integers(1, 60))
+            labels = rng.random(n) < 0.5
+            s.extend(rng.standard_normal(n) + labels, labels)
+        # the monitor saw exactly the terms the estimate is built from
+        assert h.n == s.n_terms
+        assert h.mean == pytest.approx(s.estimate(), rel=1e-12)
+        chk = h.offline_check()
+        assert chk["abs_err"]["variance"] < 1e-10
+        assert chk["abs_err"]["ci_width"] < 1e-10
+        assert "health" in s.state()
+
+    def test_facade_passthrough(self):
+        from tuplewise_tpu.estimators import StreamingEstimator
+
+        h = EstimateHealth()
+        est = StreamingEstimator(budget=8, reservoir=64, engine="numpy",
+                                 health=h)
+        rng = np.random.default_rng(3)
+        # several batches: arrivals only pair with PAST history, so a
+        # single extend against empty reservoirs spends no terms
+        for _ in range(4):
+            labels = rng.random(50) < 0.5
+            est.extend(rng.standard_normal(50) + labels, labels)
+        rep = est.health_report()
+        assert rep is not None and rep["n_terms"] == h.n > 0
+        assert StreamingEstimator(engine="numpy").health_report() is None
+
+
+class TestDriftDetector:
+    def test_transition_fires_once_with_flight_and_gauges(self):
+        reg = MetricsRegistry()
+        fl = FlightRecorder()
+        d = DriftDetector(window=4, threshold=0.1, metrics=reg,
+                          flight=fl)
+        for _ in range(4):
+            assert not d.observe(0.5, 0.5)
+        fired = [d.observe(0.8, 0.5) for _ in range(3)]
+        assert fired == [False, True, False]   # mean crosses at #2
+        assert d.alerts == 1
+        assert len(fl.events("health_drift")) == 1
+        snap = reg.snapshot()
+        assert snap["drift_alerts_total"]["value"] == 1
+        # window holds [0, 0.3, 0.3, 0.3] after the third bad pair
+        assert snap["estimate_drift"]["value"] == pytest.approx(0.225)
+        # recovery clears the live state, keeps the alert count
+        for _ in range(8):
+            d.observe(0.5, 0.5)
+        assert not d.drifting and d.alerts == 1
+
+    def test_min_fill_suppresses_early_noise(self):
+        d = DriftDetector(window=8, threshold=0.01)
+        assert not d.observe(1.0, 0.0)     # huge gap, window not full
+        assert not d.drifting
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DriftDetector(window=0)
+        with pytest.raises(ValueError):
+            DriftDetector(threshold=0.0)
+
+
+class TestShardBalance:
+    def test_balanced_and_skewed(self):
+        b = shard_balance([100, 100, 100, 100])
+        assert b["skew"] == pytest.approx(1.0)
+        assert b["cv"] == pytest.approx(0.0)
+        b = shard_balance([300, 50, 50, 0])
+        assert b["skew"] == pytest.approx(3.0)
+        assert b["max"] == 300 and b["min"] == 0
+        assert b["cv"] > 1.0
+
+    def test_empty(self):
+        assert shard_balance([])["skew"] == 1.0
+        assert shard_balance([0, 0])["skew"] == 1.0
+
+
+class TestEngineIntegration:
+    def test_replay_exports_health_gauges_matching_offline(self):
+        """The acceptance pair [ISSUE 7]: the engine's live CI-width
+        gauge equals an offline recomputation driven by the same
+        stream/seed through a term-retaining monitor."""
+        from tuplewise_tpu.serving import ServingConfig
+        from tuplewise_tpu.serving.replay import make_stream, replay
+        from tuplewise_tpu.serving.streaming import StreamingIncompleteU
+
+        scores, labels = make_stream(1200, seed=7)
+        cfg = ServingConfig(policy="block", compact_every=512,
+                            budget=16, reservoir=256, seed=7,
+                            max_batch=64)
+        # max_inflight=1 serializes requests, so every micro-batch is
+        # exactly one 64-event chunk — the offline twin below can then
+        # replay the identical batch slicing
+        rec = replay(scores, labels, config=cfg, chunk=64,
+                     max_inflight=1)
+        snap_terms = rec["incomplete_pairs"]
+        h = EstimateHealth(retain_terms=True)
+        s = StreamingIncompleteU(budget=16, reservoir=256, seed=7,
+                                 health=h)
+        for i in range(0, 1200, 64):
+            s.extend(scores[i:i + 64], labels[i:i + 64])
+        assert h.n == snap_terms == s.n_terms
+        chk = h.offline_check()
+        assert chk["abs_err"]["ci_width"] < 1e-10
+
+    def test_engine_stats_carry_drift_state(self):
+        from tuplewise_tpu.serving import MicroBatchEngine
+
+        with MicroBatchEngine(policy="block", budget=4,
+                              reservoir=64) as eng:
+            rng = np.random.default_rng(0)
+            for _ in range(3):    # separate batches: terms need history
+                labels = rng.random(40) < 0.5
+                eng.insert(rng.standard_normal(40) + labels,
+                           labels).result(10)
+            st = eng.flush()
+            assert "drift" in st
+            assert st["drift"]["alerts"] == 0
+            assert st["streaming"]["health"]["n_terms"] > 0
+            snap = st["metrics"]
+            assert snap["estimate_ci_width"]["value"] > 0
+
+    def test_health_off_switch(self):
+        from tuplewise_tpu.serving import MicroBatchEngine
+
+        with MicroBatchEngine(policy="block", health=False) as eng:
+            eng.insert([1.0, -1.0], [1, 0]).result(10)
+            st = eng.flush()
+            assert "drift" not in st
+            assert "health" not in st["streaming"]
+            assert "estimate_ci_width" not in st["metrics"]
+
+
+class TestShardedIndexGauges:
+    def test_shard_occupancy_and_skew_gauges(self):
+        from tuplewise_tpu.serving.index import ExactAucIndex
+
+        idx = ExactAucIndex(engine="jax", shards=2, compact_every=64)
+        rng = np.random.default_rng(0)
+        for i in range(0, 512, 64):
+            labels = rng.random(64) < 0.5
+            idx.insert_batch(
+                rng.standard_normal(64).astype(np.float32), labels)
+        occ = idx.shard_occupancy()
+        assert len(occ) == 2
+        # placed rows = base + delta of both classes
+        placed = sum(
+            len(side.placed_base if side.placed_base is not None
+                else side.base) + len(side.delta_run)
+            for side in (idx._pos, idx._neg))
+        assert sum(occ) == placed > 0
+        snap = idx.metrics.snapshot()
+        assert snap["shard_skew"]["value"] >= 1.0
+        # contiguous-slice placement: within one row of perfect
+        assert snap["shard_skew"]["value"] < 1.1
+        idx.close()

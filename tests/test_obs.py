@@ -3,6 +3,7 @@ obs.tracing.Tracer, obs.flight.FlightRecorder,
 obs.metrics_export.MetricsFlusher, obs.report."""
 
 import json
+import os
 import threading
 import time
 
@@ -292,3 +293,72 @@ class TestReport:
         rep = service_report(MetricsRegistry().snapshot())
         assert rep["stage_attribution"] is None
         assert rep["insert_stage_p99_ms"] == {}
+
+
+class TestFlusherRotationAndObservers:
+    """[ISSUE 7 satellite] max-bytes rotation + the observer hook the
+    SLO monitor rides."""
+
+    def test_max_bytes_rolls_to_dot_one(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        p = str(tmp_path / "m.jsonl")
+        fl = MetricsFlusher(reg, p, every_s=10.0, max_bytes=256)
+        n = 40
+        for _ in range(n):
+            fl.flush()
+        fl.stop()
+        assert fl.rotations >= 2
+        roll = p + ".1"
+        assert os.path.exists(roll) and os.path.exists(p)
+        # both generations hold only WHOLE rows, seqs stay monotonic
+        rows = [json.loads(x) for x in open(roll)] \
+            + [json.loads(x) for x in open(p)]
+        seqs = [r["seq"] for r in rows]
+        assert seqs == sorted(seqs)
+        assert seqs[-1] == n + 1    # n flushes + stop()'s final row
+        # bounded: live file + one roll, each near the cap
+        assert os.path.getsize(p) <= 256 + 512
+        assert os.path.getsize(roll) <= 256 + 512
+
+    def test_rotation_validation(self):
+        with pytest.raises(ValueError, match="max_bytes"):
+            MetricsFlusher(MetricsRegistry(), "x.jsonl", max_bytes=0)
+
+    def test_observers_see_every_row(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(7)
+        rows = []
+        p = str(tmp_path / "m.jsonl")
+        fl = MetricsFlusher(reg, p, every_s=10.0,
+                            observers=[rows.append])
+        fl.start()
+        fl.stop()
+        assert len(rows) >= 2
+        assert rows[0]["metrics"]["c"]["value"] == 7
+        disk = [json.loads(x) for x in open(p)]
+        assert [r["seq"] for r in rows] == [r["seq"] for r in disk]
+
+    def test_observer_only_flusher_without_path(self):
+        reg = MetricsRegistry()
+        seen = []
+        fl = MetricsFlusher(reg, None, every_s=10.0,
+                            observers=[seen.append])
+        fl.start()
+        fl.stop()
+        assert len(seen) >= 2
+        assert fl.last_flush_error is None
+
+    def test_observer_exception_never_kills_flusher(self, tmp_path):
+        reg = MetricsRegistry()
+
+        def bad(row):
+            raise RuntimeError("observer bug")
+
+        p = str(tmp_path / "m.jsonl")
+        fl = MetricsFlusher(reg, p, every_s=10.0, observers=[bad])
+        fl.flush()
+        fl.flush()
+        fl.stop()
+        assert fl.last_flush_error is not None
+        assert len([x for x in open(p)]) == 3

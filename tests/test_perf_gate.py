@@ -1,0 +1,137 @@
+"""scripts/perf_gate.py [ISSUE 7]: noise-banded regression gating over
+the results/serving.jsonl trajectory, run-id/config-digest joins."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "perf_gate", os.path.join(_repo, "scripts", "perf_gate.py"))
+perf_gate = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(perf_gate)
+
+
+def _row(evps, p99, digest=None, run_id=None, stage="bench_streaming"):
+    row = {"stage": stage, "metric": "events/sec", "value": evps,
+           "insert_latency_p99_ms": p99, "n_events": 300000,
+           "bg_compact": True, "max_inflight": 64}
+    if digest:
+        row["config_digest"] = digest
+    if run_id:
+        row["run_id"] = run_id
+    return row
+
+
+def _write(path, rows):
+    with open(path, "w", encoding="utf-8") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+HIST = [_row(17000 + 200 * i, 4.5 + 0.1 * i) for i in range(4)]
+
+
+class TestGate:
+    def test_within_band_passes(self):
+        v = perf_gate.gate(HIST + [_row(16900, 4.8, digest="d1")],
+                           0.15, 4.0, 2)
+        assert v["ok"]
+        assert all(c["ok"] for c in v["checks"])
+        assert v["n_history"] == 4
+
+    def test_throughput_regression_fails(self):
+        v = perf_gate.gate(HIST + [_row(9000, 4.6, digest="d1")],
+                           0.15, 4.0, 2)
+        assert not v["ok"]
+        bad = [c for c in v["checks"] if not c["ok"]]
+        assert [c["metric"] for c in bad] == ["events_per_s"]
+        assert bad[0]["new"] < bad[0]["limit"]
+
+    def test_latency_regression_fails(self):
+        v = perf_gate.gate(HIST + [_row(17200, 40.0)], 0.15, 4.0, 2)
+        assert not v["ok"]
+        assert [c["metric"] for c in v["checks"] if not c["ok"]] == \
+            ["insert_latency_p99_ms"]
+
+    def test_insufficient_history_passes_vacuously(self):
+        v = perf_gate.gate([HIST[0], _row(1.0, 999.0)], 0.15, 4.0, 2)
+        assert v["ok"] and "insufficient" in v["note"]
+
+    def test_digest_join_prefers_same_config(self):
+        # history carries two configs; only same-digest rows gate
+        hist = ([_row(17000, 4.5, digest="dA") for _ in range(3)]
+                + [_row(5000, 50.0, digest="dB") for _ in range(3)])
+        v = perf_gate.gate(hist + [_row(16800, 4.7, digest="dA")],
+                           0.15, 4.0, 2)
+        assert v["ok"] and v["n_history"] == 3
+
+    def test_legacy_rows_without_digest_still_join(self):
+        # pre-ISSUE-7 history has no digest: joined on config fields
+        v = perf_gate.gate(HIST + [_row(16900, 4.7, digest="dNew",
+                                        run_id="r1")],
+                           0.15, 4.0, 2)
+        assert v["n_history"] == 4
+        assert v["run_id"] == "r1"
+
+    def test_different_legacy_config_does_not_join(self):
+        other = dict(_row(100.0, 400.0), n_events=5)
+        v = perf_gate.gate([other] * 3 + [_row(17000, 4.5)],
+                           0.15, 4.0, 2)
+        assert "note" in v     # nothing comparable -> vacuous pass
+
+    def test_mad_widens_band_for_noisy_history(self):
+        noisy = [_row(10000, 4.0), _row(20000, 4.0), _row(14000, 4.0),
+                 _row(26000, 4.0)]
+        # median 17000, MAD 5000 -> robust sigma ~7413; a 13000 drop
+        # clears the 4-sigma band even though it is far below 15%
+        v = perf_gate.gate(noisy + [_row(13000, 4.0)], 0.15, 4.0, 2)
+        assert v["checks"][0]["ok"]
+
+
+class TestMain:
+    def test_warn_mode_exits_zero_on_regression(self, tmp_path,
+                                                capsys):
+        hist = tmp_path / "serving.jsonl"
+        _write(hist, HIST + [_row(5000, 4.6)])
+        out = tmp_path / "gate.jsonl"
+        rc = perf_gate.main(["--history", str(hist), "--mode", "warn",
+                             "--out", str(out)])
+        assert rc == 0
+        verdict = json.loads(capsys.readouterr().out.strip())
+        assert not verdict["ok"]
+        assert json.loads(out.read_text())["mode"] == "warn"
+
+    def test_fail_mode_exits_nonzero_on_regression(self, tmp_path):
+        hist = tmp_path / "serving.jsonl"
+        _write(hist, HIST + [_row(5000, 4.6)])
+        rc = perf_gate.main(["--history", str(hist), "--mode", "fail",
+                             "--out", str(tmp_path / "g.jsonl")])
+        assert rc == 1
+
+    def test_fail_mode_passes_clean_history(self, tmp_path):
+        hist = tmp_path / "serving.jsonl"
+        _write(hist, HIST + [_row(17100, 4.7)])
+        rc = perf_gate.main(["--history", str(hist), "--mode", "fail",
+                             "--out", str(tmp_path / "g.jsonl")])
+        assert rc == 0
+
+    def test_missing_file_and_no_rows_pass(self, tmp_path, capsys):
+        assert perf_gate.main(
+            ["--history", str(tmp_path / "nope.jsonl")]) == 0
+        empty = tmp_path / "serving.jsonl"
+        _write(empty, [dict(_row(1, 1), stage="other")])
+        assert perf_gate.main(["--history", str(empty),
+                               "--out", str(tmp_path / "g.jsonl")]) == 0
+
+    def test_gates_real_repo_history_in_warn_mode(self, tmp_path):
+        """The committed trajectory must be gateable as-is (the ci.sh
+        leg runs exactly this)."""
+        path = os.path.join(_repo, "results", "serving.jsonl")
+        if not os.path.exists(path):
+            pytest.skip("no committed serving.jsonl")
+        rc = perf_gate.main(["--history", path, "--mode", "warn",
+                             "--out", str(tmp_path / "g.jsonl")])
+        assert rc == 0

@@ -248,3 +248,104 @@ class TestMetricsRegistry:
         snap = r.snapshot()
         assert snap["c"]["value"] == 3
         assert snap["h"]["count"] == 1
+
+
+class TestLabeledNameRoundTrip:
+    """[ISSUE 7 satellite] the `name{k=v}` registry-key codec: format
+    and parse must invert each other — JSONL consumers (SLO engine,
+    doctor, the future multi-tenant surface) group series by it."""
+
+    @pytest.mark.parametrize("name,labels", [
+        ("m", None),
+        ("m", {"k": "v"}),
+        ("insert_latency_s", {"tenant": "t42", "shard": "3"}),
+        ("g", {"b": "2", "a": "1", "c": "0"}),      # sorted keys
+    ])
+    def test_round_trip(self, name, labels):
+        from tuplewise_tpu.utils.profiling import (
+            labeled_name, parse_labeled_name,
+        )
+
+        key = labeled_name(name, labels)
+        back_name, back_labels = parse_labeled_name(key)
+        assert back_name == name
+        want = ({k: str(v) for k, v in labels.items()}
+                if labels else None)
+        assert back_labels == want
+        # the codec is canonical: re-encoding parses back identically
+        assert labeled_name(back_name, back_labels) == key
+
+    def test_registry_keys_parse(self):
+        from tuplewise_tpu.utils.profiling import parse_labeled_name
+
+        r = MetricsRegistry()
+        r.gauge("slo_breached", labels={"objective": "p99"}).set(1)
+        r.counter("plain").inc()
+        keys = sorted(r.snapshot())
+        parsed = dict(parse_labeled_name(k) for k in keys)
+        assert parsed["plain"] is None
+        assert parsed["slo_breached"] == {"objective": "p99"}
+
+    def test_malformed_label_raises(self):
+        from tuplewise_tpu.utils.profiling import parse_labeled_name
+
+        with pytest.raises(ValueError, match="malformed"):
+            parse_labeled_name("m{novalue}")
+
+    def test_braceless_value_passthrough(self):
+        from tuplewise_tpu.utils.profiling import parse_labeled_name
+
+        assert parse_labeled_name("m{a=1") == ("m{a=1", None)
+
+
+class TestGaugeConcurrency:
+    def test_concurrent_set_add_and_snapshot(self):
+        """[ISSUE 7 satellite] a Gauge hammered by set/add from
+        batcher-like and flusher-like threads must neither lose adds
+        nor tear reads."""
+        import threading
+
+        g = Gauge("g")
+        g.set(0.0)
+        N = 2000
+        seen = []
+        stop = threading.Event()
+
+        def adder(sign):
+            for _ in range(N):
+                g.add(sign)
+
+        def reader():
+            while not stop.is_set():
+                v = g.value          # must never raise / tear
+                seen.append(v)
+
+        threads = [threading.Thread(target=adder, args=(+1,)),
+                   threading.Thread(target=adder, args=(+1,)),
+                   threading.Thread(target=adder, args=(-1,)),
+                   threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        for t in threads[:3]:
+            t.join()
+        stop.set()
+        threads[3].join()
+        # two +N adders and one -N adder: every delta retained
+        assert g.value == N
+        assert all(isinstance(v, float) for v in seen)
+
+    def test_interleaved_set_wins_are_last_write(self):
+        import threading
+
+        g = Gauge("depth")
+        barrier = threading.Barrier(2)
+
+        def setter(val):
+            barrier.wait()
+            for _ in range(1000):
+                g.set(val)
+
+        t1 = threading.Thread(target=setter, args=(3.0,))
+        t2 = threading.Thread(target=setter, args=(7.0,))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert g.value in (3.0, 7.0)   # a real write, never a tear

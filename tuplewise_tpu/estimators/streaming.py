@@ -44,19 +44,22 @@ class StreamingEstimator:
         None = unbounded.
       engine: exact-index count/compaction engine, "jax" or "numpy".
       seed: RNG seed for the incomplete path's partner draws.
+      health: optional ``obs.health.EstimateHealth`` receiving every
+        kernel-term batch — CI-width/variance tracking of the
+        incomplete estimate [ISSUE 7]; ``health_report()`` renders it.
     """
 
     def __init__(self, kernel: str = "auc", *, budget: int = 64,
                  reservoir: int = 4096, design: str = "swr",
                  window: Optional[int] = None, compact_every: int = 512,
-                 engine: str = "jax", seed: int = 0):
+                 engine: str = "jax", seed: int = 0, health=None):
         self.kernel_name = kernel if isinstance(kernel, str) else kernel.name
         self.index = ExactAucIndex(
             window=window, compact_every=compact_every, engine=engine,
         ) if self.kernel_name == "auc" else None
         self.streaming = StreamingIncompleteU(
             kernel=kernel, budget=budget, reservoir=reservoir,
-            design=design, seed=seed,
+            design=design, seed=seed, health=health,
         )
 
     # ------------------------------------------------------------------ #
@@ -98,6 +101,13 @@ class StreamingEstimator:
     def n_neg(self) -> int:
         return self.index.n_neg if self.index is not None else \
             self.streaming._neg.seen
+
+    def health_report(self) -> Optional[dict]:
+        """The CI-width monitor's state (None when no ``health`` was
+        attached) — variance / std error / i.i.d. and batch-mean CI
+        widths of the incomplete estimate."""
+        h = self.streaming.health
+        return None if h is None else h.state()
 
     def state(self) -> dict:
         out = {"kernel": self.kernel_name,

@@ -172,12 +172,29 @@ def _serve_stdin(cfg, chaos=None, obs=None) -> int:
 
     tracer = Tracer() if obs is not None and obs.trace_out else None
     flusher = None
+    slo_monitor = None
     with MicroBatchEngine(cfg, chaos=chaos, tracer=tracer) as eng:
-        if obs is not None and obs.metrics_out:
+        if obs is not None and getattr(obs, "slo_spec", None):
+            # live SLO evaluation [ISSUE 7]: the monitor rides the
+            # metrics flusher (observer-only when no --metrics-out)
+            from tuplewise_tpu.obs.slo import SloMonitor
+
+            slo_monitor = SloMonitor(
+                obs.slo_spec, registry=eng.metrics, flight=eng.flight,
+                context=dataclasses.asdict(cfg))
+        if obs is not None and (obs.metrics_out
+                                or slo_monitor is not None):
+            every = obs.metrics_every
+            if slo_monitor is not None:
+                short = slo_monitor.spec.shortest_window_s
+                if short:
+                    every = min(every, max(short / 4.0, 0.05))
             flusher = MetricsFlusher(
-                eng.metrics, obs.metrics_out,
-                every_s=obs.metrics_every,
-                meta={"stage": "serve"}, config=cfg).start()
+                eng.metrics, obs.metrics_out or None,
+                every_s=every,
+                meta={"stage": "serve"}, config=cfg,
+                observers=([slo_monitor.observe_row]
+                           if slo_monitor is not None else ())).start()
         with _jax_trace(obs.profile_dir if obs is not None else None):
             for line in sys.stdin:
                 line = line.strip()
@@ -234,7 +251,8 @@ def _serve_stdin(cfg, chaos=None, obs=None) -> int:
     # exit summary: the load-shedding, pause, and recovery numbers an
     # operator greps for first, ahead of the full metrics dump — built
     # by the SAME report builder replay records use [ISSUE 6 satellite]
-    summary = service_report(m, chaos=chaos, flight=flight)
+    summary = service_report(m, chaos=chaos, flight=flight,
+                             slo=slo_monitor)
     print(json.dumps({"exit_summary": summary}), file=sys.stderr)
     print(json.dumps({"final_stats": m}), file=sys.stderr)
     return 0
@@ -413,6 +431,14 @@ def main(argv=None) -> int:
         p.add_argument("--flight-out", type=str, default=None,
                        help="dump the flight recorder (JSONL) here on "
                             "exit")
+        p.add_argument("--slo-spec", type=str, default=None,
+                       help="declarative SLO objectives (JSON inline, "
+                            "@file, or *.json — obs.slo spec schema, "
+                            "DESIGN §13) evaluated live against the "
+                            "metrics snapshots; breaches emit "
+                            "slo_breach flight events + slo_* gauges, "
+                            "verdicts land in the exit summary / "
+                            "replay record")
         p.add_argument("--seed", type=int, default=0)
 
     p = sub.add_parser(
@@ -422,6 +448,37 @@ def main(argv=None) -> int:
              '"score":s} | {"op":"query"}), JSONL responses on stdout',
     )
     _add_serving_flags(p)
+
+    p = sub.add_parser(
+        "doctor",
+        help="post-hoc diagnosis of a run's observability artifacts "
+             "(metrics.jsonl + flight.jsonl + span export): SLO + "
+             "statistical-health verdicts, fault->recovery "
+             "correlation, top self-time spans; the LAST stdout line "
+             "is one machine-readable verdict JSON (exit 0 = "
+             "healthy/recovered, 2 = degraded) [ISSUE 7]",
+    )
+    p.add_argument("--dir", type=str, default=None,
+                   help="artifact directory (e.g. a --snapshot-dir "
+                        "after SIGKILL): default filenames are probed "
+                        "for anything not given explicitly")
+    p.add_argument("--metrics", type=str, default=None,
+                   help="metrics.jsonl (MetricsFlusher output)")
+    p.add_argument("--flight", type=str, default=None,
+                   help="flight-recorder dump (flight.jsonl)")
+    p.add_argument("--spans", type=str, default=None,
+                   help="span export (*.jsonl span JSONL or Chrome "
+                        "trace JSON)")
+    p.add_argument("--slo-spec", type=str, default=None,
+                   help="SLO spec to re-evaluate over the metrics "
+                        "history (default: the conservative built-in "
+                        "doctor spec — no heal exhaustion, "
+                        "availability budget)")
+    p.add_argument("--top-spans", type=int, default=10)
+    p.add_argument("--out", type=str, default=None,
+                   help="also write the full report JSON here")
+    p.add_argument("--quiet", action="store_true",
+                   help="print only the one-line machine verdict")
 
     p = sub.add_parser(
         "replay",
@@ -439,6 +496,11 @@ def main(argv=None) -> int:
     p.add_argument("--out", type=str, default=None)
 
     args = ap.parse_args(argv)
+
+    if args.cmd == "doctor":
+        from tuplewise_tpu.obs.doctor import main as doctor_main
+
+        return doctor_main(args)
 
     if args.cmd in ("serve", "replay"):
         from tuplewise_tpu.serving import ServingConfig
@@ -481,7 +543,8 @@ def main(argv=None) -> int:
                        metrics_out=args.metrics_out,
                        metrics_every_s=args.metrics_every,
                        profile_dir=args.profile_dir,
-                       flight_out=args.flight_out),
+                       flight_out=args.flight_out,
+                       slo_spec=args.slo_spec),
                 args.out,
             )
             return 0

@@ -32,19 +32,50 @@ builds on:
                               exit summary and ``replay`` records, so
                               the recovery/chaos counters never drift
                               between the two again.
+
+[ISSUE 7] adds the evaluation layer that turns the telemetry above
+into verdicts:
+
+* ``slo.SloMonitor``        — declarative SLO objectives (latency
+                              quantiles, multi-window burn-rate error
+                              budgets, counter caps, saturation) over
+                              the existing metrics, judged live at
+                              each flusher snapshot; breaches emit
+                              ``slo_breach`` flight events and
+                              ``slo_*`` gauges.
+* ``health``                — statistical monitors of the estimate
+                              itself: Welford CI-width tracking
+                              (``EstimateHealth``), live-vs-oracle
+                              drift (``DriftDetector``), shard-balance
+                              skew (``shard_balance``).
+* ``doctor``                — post-hoc diagnosis of a run's artifacts
+                              (``tuplewise doctor``): SLO + health
+                              verdicts, fault->recovery correlation,
+                              top self-time spans, one machine-
+                              readable verdict line for CI.
 """
 
 from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.health import (
+    DriftDetector, EstimateHealth, shard_balance,
+)
 from tuplewise_tpu.obs.metrics_export import MetricsFlusher, config_digest
 from tuplewise_tpu.obs.report import recovery_counters, service_report
+from tuplewise_tpu.obs.slo import SloMonitor, SloSpec, evaluate_history
 from tuplewise_tpu.obs.tracing import Span, Tracer
 
 __all__ = [
+    "DriftDetector",
+    "EstimateHealth",
     "FlightRecorder",
     "MetricsFlusher",
+    "SloMonitor",
+    "SloSpec",
     "Span",
     "Tracer",
     "config_digest",
+    "evaluate_history",
     "recovery_counters",
     "service_report",
+    "shard_balance",
 ]

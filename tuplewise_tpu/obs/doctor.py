@@ -1,0 +1,421 @@
+"""Post-hoc diagnosis of a run's observability artifacts [ISSUE 7
+tentpole]: ``tuplewise doctor``.
+
+A serve/replay/bench run (or its corpse, after SIGKILL) leaves exactly
+three artifacts next to each other: ``metrics.jsonl`` (the flusher's
+periodic registry snapshots), ``flight.jsonl`` (the lifecycle ring
+dump) and a span export (JSONL or Chrome trace). The doctor reads
+whatever subset exists and renders a verdict a human or a CI gate can
+act on:
+
+* **SLO verdicts** — the metrics history replayed through
+  :mod:`tuplewise_tpu.obs.slo` (``--slo-spec``, or the conservative
+  default spec: no heal exhaustion, availability error budget).
+* **Health verdicts** — the statistical monitors' final gauges: CI
+  width of the streaming estimate, drift alerts, shard skew.
+* **Fault -> breach correlation** — every chaos injection / poison in
+  the flight dump listed EXACTLY once, each tied to its recovery
+  evidence (the batcher restart that followed it, the poison_reject
+  that shed it, the heal round that re-placed the mesh) and, when a
+  span export is present, to the span its trace id points at.
+* **Top self-time spans** — where the wall-clock went (total minus
+  direct-child time), so the breach and the hot path sit in one
+  report.
+
+Verdict taxonomy (DESIGN §13):
+
+* ``healthy``   — no faults observed, no SLO breach, no drift.
+* ``recovered`` — failures happened (chaos or real) but every one is
+                  tied to successful recovery evidence and no SLO
+                  objective breached: the system did its job. CI
+                  treats this as green — it is the *expected* verdict
+                  for a chaos smoke.
+* ``degraded``  — an SLO objective breached, a statistical monitor
+                  fired, a fault has no recovery evidence, or the
+                  process hit a terminal failure (heal exhaustion,
+                  snapshot error). CI treats this as red.
+
+The last stdout line of the CLI is one machine-readable JSON object
+(``{"doctor_verdict": ...}``) — ``tail -n 1 | python -m json.tool`` is
+the whole CI integration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+from typing import List, Optional, Tuple
+
+from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.slo import DEFAULT_DOCTOR_SPEC, evaluate_history
+
+# artifact filenames probed (in order) when only a directory is given
+_METRICS_NAMES = ("metrics.jsonl",)
+_FLIGHT_NAMES = ("flight.jsonl", "obs_flight.jsonl")
+_SPAN_NAMES = ("spans.jsonl", "obs_spans.jsonl", "trace.json",
+               "obs_trace.json")
+
+
+def load_metrics_rows(path: str) -> List[dict]:
+    """Flusher rows, torn-tail tolerant (the file of a SIGKILLed
+    process can end mid-line; keep what parses)."""
+    rows = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                break
+    return rows
+
+
+def load_spans(path: str) -> List[dict]:
+    """Spans from either export shape (span JSONL or Chrome trace
+    JSON) — self-contained so the doctor works from any checkout/cwd,
+    unlike the scripts/ summarizer."""
+    if path.endswith(".jsonl"):
+        spans = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break    # torn tail
+                if "meta" in rec:
+                    continue
+                spans.append(rec)
+        return spans
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    spans = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args", {})
+        spans.append({
+            "trace_id": args.get("trace_id"),
+            "span_id": args.get("span_id"),
+            "parent_id": args.get("parent_id"),
+            "name": e["name"],
+            "t0_s": e["ts"] / 1e6,
+            "dur_s": e.get("dur", 0.0) / 1e6,
+        })
+    return spans
+
+
+def top_self_spans(spans: List[dict], top_n: int = 10) -> List[dict]:
+    """Per-name totals ordered by SELF time (total minus direct-child
+    time) — the honest where-did-the-wall-clock-go list."""
+    child_time: dict = defaultdict(float)
+    for s in spans:
+        if s.get("parent_id") is not None:
+            child_time[s["parent_id"]] += s["dur_s"]
+    agg: dict = defaultdict(lambda: {"n": 0, "total_s": 0.0,
+                                     "self_s": 0.0})
+    for s in spans:
+        a = agg[s["name"]]
+        a["n"] += 1
+        a["total_s"] += s["dur_s"]
+        a["self_s"] += max(0.0, s["dur_s"]
+                           - child_time.get(s["span_id"], 0.0))
+    out = [dict(name=n, **a) for n, a in agg.items()]
+    out.sort(key=lambda a: -a["self_s"])
+    return out[:top_n]
+
+
+# --------------------------------------------------------------------- #
+# fault -> recovery correlation                                          #
+# --------------------------------------------------------------------- #
+
+def _metric_value(rows: List[dict], name: str, default=0):
+    if not rows:
+        return default
+    return rows[-1]["metrics"].get(name, {}).get("value", default)
+
+
+def _span_for_trace(spans: List[dict], trace_id) -> Optional[str]:
+    """The root-most span name of a trace id (None when the export
+    does not carry the trace)."""
+    members = [s for s in spans if s.get("trace_id") == trace_id]
+    if not members:
+        return None
+    roots = [s for s in members if s.get("parent_id") is None]
+    return (roots or members)[0]["name"]
+
+
+def correlate_faults(flight_events: List[dict], metrics_rows: List[dict],
+                     spans: List[dict]) -> List[dict]:
+    """One entry per injected fault (chaos_inject, plus chaos_poison
+    expanded per poisoned event position), each carrying its recovery
+    evidence. ``resolved=False`` entries push the verdict to
+    degraded."""
+    faults = []
+    by_kind: dict = defaultdict(list)
+    for e in flight_events:
+        by_kind[e["kind"]].append(e)
+
+    def _after(kind: str, seq: int) -> Optional[dict]:
+        for e in by_kind.get(kind, ()):
+            if e["seq"] > seq:
+                return e
+        return None
+
+    for e in by_kind.get("chaos_inject", ()):
+        point = e.get("point")
+        entry = {
+            "kind": "chaos_inject", "point": point, "seq": e["seq"],
+            "t_wall": e.get("t_wall"), "action": e.get("action"),
+            "trace_id": e.get("trace_id"),
+            "trace_span": _span_for_trace(spans, e.get("trace_id")),
+        }
+        resolution = evidence = None
+        if point == "batcher":
+            r = _after("batcher_restart", e["seq"])
+            if r is not None:
+                resolution = "batcher_restart"
+                evidence = {"seq": r["seq"]}
+            elif _metric_value(metrics_rows, "batcher_restarts") > 0:
+                resolution = "batcher_restart"
+                evidence = {"batcher_restarts": _metric_value(
+                    metrics_rows, "batcher_restarts")}
+        elif point == "compactor_build":
+            r = _after("compaction", e["seq"])
+            n_restarts = _metric_value(metrics_rows,
+                                       "bg_compactor_restarts")
+            if r is not None:
+                resolution = "compaction_resumed"
+                evidence = {"next_compaction_seq": r["seq"],
+                            "bg_compactor_restarts": n_restarts}
+            elif n_restarts > 0:
+                resolution = "compactor_restarted"
+                evidence = {"bg_compactor_restarts": n_restarts}
+        elif point in ("sharded_count", "place_base"):
+            r = _after("heal", e["seq"])
+            if r is not None:
+                resolution = "healed"
+                evidence = {"seq": r["seq"],
+                            "mesh_width": r.get("mesh_width")}
+        elif point == "major_merge":
+            r = (_after("major_merge_fallback", e["seq"])
+                 or _after("major_merge", e["seq"]))
+            if r is not None:
+                resolution = r["kind"]
+                evidence = {"seq": r["seq"]}
+            elif _metric_value(metrics_rows,
+                               "major_merge_fallbacks") > 0:
+                resolution = "major_merge_fallback"
+                evidence = {"major_merge_fallbacks": _metric_value(
+                    metrics_rows, "major_merge_fallbacks")}
+        elif point in ("train_step", "mc_chunk", "mesh_mc",
+                       "estimator", "checkpoint", "dist_init"):
+            r = _after("heal", e["seq"])
+            if r is not None:
+                resolution = "healed"
+                evidence = {"seq": r["seq"]}
+        entry["resolution"] = resolution
+        entry["resolved"] = resolution is not None
+        entry["evidence"] = evidence
+        faults.append(entry)
+
+    # poison injections: one fault entry PER poisoned stream position,
+    # each resolved by the engine's edge validation (poison_reject
+    # events / counter)
+    rejects = by_kind.get("poison_reject", ())
+    n_rejects = max(len(rejects),
+                    _metric_value(metrics_rows, "poison_rejects"))
+    n_poisoned = 0
+    for e in by_kind.get("chaos_poison", ()):
+        positions = e.get("at_events") or [None] * int(
+            e.get("n_poisoned", 1))
+        for pos in positions:
+            n_poisoned += 1
+            faults.append({
+                "kind": "chaos_poison", "point": "poison",
+                "seq": e["seq"], "t_wall": e.get("t_wall"),
+                "at_event": pos, "trace_id": e.get("trace_id"),
+                "trace_span": _span_for_trace(spans, e.get("trace_id")),
+                "resolution": ("poison_rejected"
+                               if n_poisoned <= n_rejects else None),
+                "resolved": n_poisoned <= n_rejects,
+                "evidence": {"poison_rejects": n_rejects},
+            })
+    faults.sort(key=lambda f: f["seq"])
+    return faults
+
+
+# --------------------------------------------------------------------- #
+# diagnosis                                                              #
+# --------------------------------------------------------------------- #
+
+def _probe(run_dir: str, names: Tuple[str, ...]) -> Optional[str]:
+    for n in names:
+        p = os.path.join(run_dir, n)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def diagnose(metrics_path: Optional[str] = None,
+             flight_path: Optional[str] = None,
+             spans_path: Optional[str] = None,
+             run_dir: Optional[str] = None,
+             slo_spec=None, context: Optional[dict] = None,
+             top_n: int = 10) -> dict:
+    """Build the structured diagnosis report from whatever artifacts
+    exist. ``run_dir`` probes default filenames for anything not given
+    explicitly (the post-SIGKILL case: point it at --snapshot-dir)."""
+    if run_dir:
+        metrics_path = metrics_path or _probe(run_dir, _METRICS_NAMES)
+        flight_path = flight_path or _probe(run_dir, _FLIGHT_NAMES)
+        spans_path = spans_path or _probe(run_dir, _SPAN_NAMES)
+    if not (metrics_path or flight_path):
+        raise FileNotFoundError(
+            "doctor needs at least a metrics.jsonl or a flight dump "
+            f"(run_dir={run_dir!r})")
+
+    metrics_rows = load_metrics_rows(metrics_path) if metrics_path \
+        and os.path.exists(metrics_path) else []
+    flight_events: List[dict] = []
+    flight_header: dict = {}
+    if flight_path and os.path.exists(flight_path):
+        flight_header = FlightRecorder.load_dump(flight_path)
+        flight_events = flight_header.pop("events")
+    spans = load_spans(spans_path) if spans_path \
+        and os.path.exists(spans_path) else []
+
+    report: dict = {
+        "artifacts": {
+            "metrics": metrics_path, "flight": flight_path,
+            "spans": spans_path,
+            "metrics_rows": len(metrics_rows),
+            "flight_events": len(flight_events),
+            "spans_loaded": len(spans),
+        },
+    }
+
+    # run window + identity from the metrics history
+    if metrics_rows:
+        first, last = metrics_rows[0], metrics_rows[-1]
+        report["run"] = {
+            "duration_s": last["ts_mono"] - first["ts_mono"],
+            "platform": last.get("platform"),
+            "config_digest": last.get("config_digest"),
+            "stage": last.get("stage"),
+            "events_total": _metric_value(metrics_rows, "events_total"),
+        }
+
+    # SLO verdicts over the metrics history
+    slo_report = None
+    if metrics_rows:
+        slo_report = evaluate_history(
+            slo_spec if slo_spec is not None else DEFAULT_DOCTOR_SPEC,
+            metrics_rows, context=context)
+    report["slo"] = slo_report
+
+    # statistical-health verdicts: the monitors' final gauges
+    m = metrics_rows[-1]["metrics"] if metrics_rows else {}
+
+    def _g(name):
+        return m.get(name, {}).get("value")
+
+    health = {
+        "estimate_ci_width": _g("estimate_ci_width"),
+        "estimate_std_error": _g("estimate_std_error"),
+        "estimate_terms": _g("estimate_terms"),
+        "estimate_drift": _g("estimate_drift"),
+        "drift_alerts": _g("drift_alerts_total") or 0,
+        "shard_skew": _g("shard_skew"),
+        "shard_balance_cv": _g("shard_balance_cv"),
+    }
+    report["health"] = health
+
+    # fault -> breach correlation
+    faults = correlate_faults(flight_events, metrics_rows, spans)
+    report["faults"] = faults
+    kinds: dict = {}
+    for e in flight_events:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    report["flight_summary"] = kinds
+
+    report["top_self_spans"] = top_self_spans(spans, top_n)
+
+    # the recovery counter block every exit summary carries, read from
+    # the final snapshot — same builder, same keys (report parity)
+    if metrics_rows:
+        from tuplewise_tpu.obs.report import recovery_counters
+
+        report["recovery_counters"] = recovery_counters(m)
+
+    report["verdict"] = _verdict(report, kinds)
+    report["verdict_line"] = verdict_line(report)
+    return report
+
+
+def _verdict(report: dict, kinds: dict) -> str:
+    degraded = []
+    slo = report.get("slo")
+    if slo is not None and not slo["healthy"]:
+        degraded.append("slo_breached")
+    if report["health"]["drift_alerts"]:
+        degraded.append("estimate_drift")
+    if kinds.get("heal_exhausted"):
+        degraded.append("heal_exhausted")
+    if kinds.get("snapshot_error"):
+        degraded.append("snapshot_error")
+    unresolved = [f for f in report["faults"] if not f["resolved"]]
+    if unresolved:
+        degraded.append(f"{len(unresolved)}_unresolved_faults")
+    if degraded:
+        return "degraded:" + ",".join(degraded)
+    # failures that DID happen and were recovered from
+    had_failures = (bool(report["faults"])
+                    or kinds.get("batcher_restart")
+                    or kinds.get("heal"))
+    return "recovered" if had_failures else "healthy"
+
+
+def verdict_line(report: dict) -> dict:
+    """The one-line machine-readable verdict (last stdout line of the
+    CLI; ``tail -n 1`` is the whole CI integration)."""
+    v = report["verdict"]
+    slo = report.get("slo") or {}
+    return {
+        "doctor_verdict": v.split(":", 1)[0],
+        "detail": v.split(":", 1)[1] if ":" in v else None,
+        "healthy": v in ("healthy", "recovered"),
+        "faults": len(report["faults"]),
+        "faults_resolved": sum(1 for f in report["faults"]
+                               if f["resolved"]),
+        "slo_breaches": sum(
+            o["breaches_total"]
+            for o in slo.get("objectives", {}).values()),
+        "drift_alerts": report["health"]["drift_alerts"],
+    }
+
+
+def main(args) -> int:
+    """CLI entry point (argparse namespace from harness/cli.py):
+    pretty report to stdout, the machine verdict as the LAST stdout
+    line; exit 0 on healthy/recovered, 2 on degraded."""
+    report = diagnose(
+        metrics_path=args.metrics, flight_path=args.flight,
+        spans_path=args.spans, run_dir=args.dir,
+        slo_spec=args.slo_spec, top_n=args.top_spans)
+    if args.out:
+        d = os.path.dirname(args.out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+    line = report.pop("verdict_line")
+    if not args.quiet:
+        print(json.dumps(report, indent=2))
+    print(json.dumps(line))
+    return 0 if line["healthy"] else 2

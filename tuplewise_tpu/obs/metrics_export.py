@@ -17,6 +17,18 @@ DESIGN §9.)
 
 ``flush()`` is also called once at ``start()`` and once at ``stop()``,
 so even a short run leaves >= 2 snapshots — enough to difference.
+
+[ISSUE 7] Two growth points on the same thread:
+
+* **rotation** — ``max_bytes`` rolls ``metrics.jsonl`` to
+  ``metrics.jsonl.1`` (one generation, replaced on the next roll) when
+  an append pushes past the bound, so a long-running serve cannot grow
+  the file without limit; the flushed-not-fsynced stance is unchanged.
+* **observers** — callables invoked with each flushed row; the SLO
+  monitor rides here, so "evaluate the SLOs" costs no second timer
+  thread and judges exactly the snapshots the file records. ``path``
+  may be ``None`` for an observer-only flusher (``--slo-spec`` without
+  ``--metrics-out``).
 """
 
 from __future__ import annotations
@@ -55,23 +67,37 @@ class MetricsFlusher:
     Args:
       registry: the ``utils.profiling.MetricsRegistry`` to snapshot.
       path: JSONL output (parent dirs created; appended, not truncated
-        — restarts of the same service extend one history file).
+        — restarts of the same service extend one history file). None
+        = observer-only: snapshots are built and handed to observers,
+        nothing is written.
       every_s: cadence between snapshots.
       meta: extra fields stamped on every row (e.g. ``stage``); the
         platform and ``config_digest`` ride along automatically when
         ``config`` is given.
       config: config object/dict digested into ``config_digest``.
+      max_bytes: roll ``path`` to ``path + ".1"`` when an append
+        pushes past this size (None = never roll).
+      observers: callables receiving each flushed row dict (on the
+        flusher thread; exceptions are swallowed into
+        ``last_flush_error`` — observation must not kill the flusher).
 
     Use as a context manager, or ``start()`` / ``stop()``.
     """
 
-    def __init__(self, registry, path: str, every_s: float = 1.0,
-                 meta: Optional[dict] = None, config=None):
+    def __init__(self, registry, path: Optional[str],
+                 every_s: float = 1.0,
+                 meta: Optional[dict] = None, config=None,
+                 max_bytes: Optional[int] = None, observers=()):
         if every_s <= 0:
             raise ValueError(f"every_s must be > 0: {every_s}")
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1: {max_bytes}")
         self.registry = registry
         self.path = path
         self.every_s = every_s
+        self.max_bytes = max_bytes
+        self.observers = list(observers)
+        self.rotations = 0
         self.meta = dict(meta or {})
         self.meta.setdefault("platform", _platform())
         if config is not None:
@@ -98,15 +124,29 @@ class MetricsFlusher:
             row.update(self.meta)
             row["metrics"] = self.registry.snapshot()
             try:
-                if self._f is None:
-                    d = os.path.dirname(self.path)
-                    if d:
-                        os.makedirs(d, exist_ok=True)
-                    self._f = open(self.path, "a", encoding="utf-8")
-                self._f.write(json.dumps(row) + "\n")
-                self._f.flush()
+                if self.path is not None:
+                    if self._f is None:
+                        d = os.path.dirname(self.path)
+                        if d:
+                            os.makedirs(d, exist_ok=True)
+                        self._f = open(self.path, "a", encoding="utf-8")
+                    self._f.write(json.dumps(row) + "\n")
+                    self._f.flush()
+                    if (self.max_bytes is not None
+                            and self._f.tell() >= self.max_bytes):
+                        # roll AFTER a complete row: both generations
+                        # always hold whole lines
+                        self._f.close()
+                        self._f = None
+                        os.replace(self.path, self.path + ".1")
+                        self.rotations += 1
             except Exception as e:   # noqa: BLE001 — lossy by design
                 self.last_flush_error = repr(e)
+            for obs in self.observers:
+                try:
+                    obs(row)
+                except Exception as e:   # noqa: BLE001 — see docstring
+                    self.last_flush_error = repr(e)
             return self._seq
 
     def _run(self) -> None:

@@ -88,7 +88,7 @@ def stage_attribution(metrics: dict) -> Optional[dict]:
 
 
 def service_report(metrics: dict, chaos=None,
-                   flight=None) -> dict:
+                   flight=None, slo=None) -> dict:
     """The shared serving report: load-shedding, compaction, transfer,
     latency (with per-stage p99 attribution), and recovery counters —
     the block ``tuplewise serve`` prints as its exit summary and
@@ -100,6 +100,8 @@ def service_report(metrics: dict, chaos=None,
         along under ``"chaos"``.
       flight: optional ``FlightRecorder`` — per-kind event counts ride
         along under ``"flight_events"``.
+      slo: optional ``obs.slo.SloMonitor`` (or a prebuilt report dict)
+        — verdicts ride along under ``"slo"`` [ISSUE 7].
     """
     report = {
         "rejected_total": _v(metrics, "rejected_total"),
@@ -122,4 +124,6 @@ def service_report(metrics: dict, chaos=None,
         report["chaos"] = chaos.snapshot()
     if flight is not None:
         report["flight_events"] = flight.counts()
+    if slo is not None:
+        report["slo"] = slo.report() if hasattr(slo, "report") else slo
     return report

@@ -135,6 +135,14 @@ class ServingConfig:
     # flight recorder [ISSUE 6]: lifecycle-event ring size; the dump
     # lands next to the recovery snapshots when snapshot_dir is set
     flight_recorder_size: int = 4096
+    # statistical health [ISSUE 7]: CI-width tracking of the streaming
+    # estimate (obs.health.EstimateHealth gauges) and a windowed drift
+    # check of the live incomplete estimate against the exact oracle
+    # prefix (AUC kernel only). Cheap enough to default ON — one
+    # Welford merge per kernel batch, one deque append per micro-batch.
+    health: bool = True
+    drift_window: int = 256        # micro-batches in the drift window
+    drift_threshold: float = 0.05  # rolling |live - oracle| that alerts
     seed: int = 0
 
     def __post_init__(self):
@@ -165,6 +173,12 @@ class ServingConfig:
             raise ValueError(
                 f"flight_recorder_size must be >= 1: "
                 f"{self.flight_recorder_size}")
+        if self.drift_window < 1:
+            raise ValueError(
+                f"drift_window must be >= 1: {self.drift_window}")
+        if self.drift_threshold <= 0:
+            raise ValueError(
+                f"drift_threshold must be > 0: {self.drift_threshold}")
 
 
 class _Request:
@@ -219,10 +233,26 @@ class MicroBatchEngine:
             max_delta_runs=config.max_delta_runs,
             tracer=tracer, flight=self.flight,
         ) if config.kernel == "auc" else None
+        # statistical health [ISSUE 7]: the CI-width monitor is fed by
+        # the streaming estimator itself (every kernel-term batch); the
+        # drift detector is fed per micro-batch below. Both export live
+        # gauges into this registry, so the SLO layer and the flusher's
+        # JSONL see estimation health next to latency.
+        self._est_health = self._drift = None
+        if config.health:
+            from tuplewise_tpu.obs.health import (
+                DriftDetector, EstimateHealth,
+            )
+
+            self._est_health = EstimateHealth(metrics=self.metrics)
+            self._drift = DriftDetector(
+                window=config.drift_window,
+                threshold=config.drift_threshold,
+                metrics=self.metrics, flight=self.flight)
         self.streaming = StreamingIncompleteU(
             kernel=config.kernel, budget=config.budget,
             reservoir=config.reservoir, design=config.design,
-            seed=config.seed,
+            seed=config.seed, health=self._est_health,
         )
         m = self.metrics
         self._c_req = {k: m.counter(f"requests_{k}_total") for k in _KINDS}
@@ -567,6 +597,14 @@ class MicroBatchEngine:
         for r in run:
             qw.observe(t_start - r.t_enqueue)
             self._h_insert_lat.observe(t_end - r.t_enqueue)
+        # drift check [ISSUE 7]: live budgeted estimate vs the exact
+        # oracle prefix, once per micro-batch, AFTER the latency
+        # boundaries — bookkeeping, not request service
+        if self._drift is not None and self.index is not None:
+            live = self.streaming.estimate()
+            oracle = self.index.auc()
+            if live is not None and oracle is not None:
+                self._drift.observe(live, oracle)
         if self.tracer is not None:
             self._trace_insert_run(
                 run, (t_start, t_lock, t_wal, t_index, t_stream,
@@ -619,6 +657,8 @@ class MicroBatchEngine:
                 "metrics": self.metrics.snapshot(),
                 "streaming": self.streaming.state(),
             }
+            if self._drift is not None:
+                out["drift"] = self._drift.state()
             if self.index is not None:
                 out["index"] = self.index.state()
                 out["auc_exact"] = self.index.auc()

@@ -11,6 +11,7 @@ northstar ``serve`` stage.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Optional
 
@@ -44,6 +45,7 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
            metrics_every_s: float = 1.0,
            profile_dir: Optional[str] = None,
            flight_out: Optional[str] = None,
+           slo_spec=None, run_id: Optional[str] = None,
            **overrides) -> dict:
     """Drive the engine with one request per event (or per ``chunk``
     events) and return the measurement record.
@@ -83,6 +85,16 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     ``profile_dir`` brackets the timed window in a ``jax.profiler``
     trace; ``flight_out`` dumps the engine's flight recorder after the
     run. The warmup pass stays untraced (it measures nothing).
+
+    ``slo_spec`` [ISSUE 7]: anything ``obs.slo.SloSpec.from_spec``
+    accepts. An ``SloMonitor`` rides the metrics flusher (an
+    observer-only flusher is created when no ``metrics_out`` is given)
+    and judges each snapshot live: breaches land as ``slo_breach``
+    flight events and ``slo_*`` gauges, and the final verdicts as the
+    record's ``slo`` block. ``run_id``: caller-chosen identity stamped
+    into the record (bench/northstar stamp one per invocation so
+    ``scripts/perf_gate.py`` can join history rows); the config digest
+    is stamped unconditionally.
     """
     scores = np.asarray(scores, dtype=np.float64).ravel()
     labels = np.asarray(labels).ravel().astype(bool)
@@ -106,13 +118,29 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     admitted = np.ones(n, dtype=bool)
     futures = []
     flusher = None
+    slo_monitor = None
     with MicroBatchEngine(cfg, chaos=injector, tracer=tracer) as eng:
-        if metrics_out:
+        if slo_spec is not None:
+            from tuplewise_tpu.obs.slo import SloMonitor
+
+            slo_monitor = SloMonitor(
+                slo_spec, registry=eng.metrics, flight=eng.flight,
+                context=dataclasses.asdict(cfg))
+        if metrics_out or slo_monitor is not None:
             from tuplewise_tpu.obs.metrics_export import MetricsFlusher
 
+            every = metrics_every_s
+            if slo_monitor is not None:
+                # burn windows need several snapshots to fill: keep
+                # the cadence comfortably under the shortest window
+                short = slo_monitor.spec.shortest_window_s
+                if short:
+                    every = min(every, max(short / 4.0, 0.05))
             flusher = MetricsFlusher(
-                eng.metrics, metrics_out, every_s=metrics_every_s,
-                meta={"stage": "replay"}, config=cfg).start()
+                eng.metrics, metrics_out or None, every_s=every,
+                meta={"stage": "replay"}, config=cfg,
+                observers=([slo_monitor.observe_row]
+                           if slo_monitor is not None else ())).start()
         from tuplewise_tpu.utils.profiling import trace as _jax_trace
 
         with _jax_trace(profile_dir):
@@ -238,10 +266,19 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             "max_delta_runs": cfg.max_delta_runs,
         },
     }
+    # perf-history identity [ISSUE 7 satellite]: the digest joins rows
+    # of the same configuration across runs; run_id names the run
+    from tuplewise_tpu.obs.metrics_export import config_digest
+
+    rec["config_digest"] = config_digest(cfg)
+    if run_id is not None:
+        rec["run_id"] = run_id
     # the shared report [ISSUE 6 satellite]: ONE builder feeds both
     # this record and `tuplewise serve`'s exit summary, so the
     # recovery/chaos counters can never drift between them again
-    rec["report"] = service_report(stats["metrics"])
+    rec["report"] = service_report(stats["metrics"], slo=slo_monitor)
+    if slo_monitor is not None:
+        rec["slo"] = slo_monitor.report()
     if trace_out and tracer is not None:
         if trace_out.endswith(".jsonl"):
             tracer.export_jsonl(trace_out)

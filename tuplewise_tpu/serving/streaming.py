@@ -99,11 +99,16 @@ class StreamingIncompleteU:
         occupancy — the finite-population variant).
       seed: host RNG seed; the stream is reproducible given arrival
         order and batching.
+      health: optional ``obs.health.EstimateHealth`` — receives every
+        batch of kernel terms ``h`` as it is folded into the running
+        sums, so CI-width / variance tracking sees exactly the terms
+        the estimate is built from [ISSUE 7]. None costs one ``is not
+        None`` check per class-side per batch.
     """
 
     def __init__(self, kernel="auc", budget: int = 64,
                  reservoir: int = 4096, design: str = "swr",
-                 seed: int = 0):
+                 seed: int = 0, health=None):
         self.kernel: Kernel = (kernel if isinstance(kernel, Kernel)
                                else get_kernel(kernel))
         if self.kernel.kind != "diff" or not self.kernel.two_sample:
@@ -116,6 +121,7 @@ class StreamingIncompleteU:
             raise ValueError(f"design must be 'swr' or 'swor': {design!r}")
         self.budget = budget
         self.design = design
+        self.health = health
         self._rng = np.random.default_rng(seed)
         self._pos = _Reservoir(reservoir, self._rng)
         self._neg = _Reservoir(reservoir, self._rng)
@@ -154,10 +160,16 @@ class StreamingIncompleteU:
             # with positive partners, so the difference flips
             d = (partners - arr) if flip else (arr - partners)
             h = np.asarray(self.kernel.diff(d, np), dtype=np.float64)
-            self._sum_h += float(h.sum())
-            self._sum_h2 += float((h * h).sum())
+            s1 = float(h.sum())
+            s2 = float((h * h).sum())
+            self._sum_h += s1
+            self._sum_h2 += s2
             self._n_terms += h.size
             spent += h.size
+            if self.health is not None:
+                # the sums above ride along: the monitor's merge is
+                # O(1), no second pass over the terms
+                self.health.update(h, s1=s1, s2=s2)
         self._pos.add_batch(scores[labels])
         self._neg.add_batch(scores[~labels])
         self.n_arrivals += len(scores)
@@ -189,7 +201,7 @@ class StreamingIncompleteU:
         return float(np.sqrt(var / self._n_terms))
 
     def state(self) -> dict:
-        return {
+        out = {
             "estimate": self.estimate(),
             "std_error": self.std_error(),
             "n_terms": self._n_terms,
@@ -199,3 +211,6 @@ class StreamingIncompleteU:
             "reservoir_pos": self._pos.size,
             "reservoir_neg": self._neg.size,
         }
+        if self.health is not None:
+            out["health"] = self.health.state()
+        return out

@@ -84,6 +84,30 @@ def labeled_name(name: str, labels: Optional[dict]) -> str:
     return f"{name}{{{inner}}}"
 
 
+def parse_labeled_name(key: str):
+    """Inverse of :func:`labeled_name`: ``name{k=v,k2=v2}`` back to
+    ``(name, labels-or-None)`` [ISSUE 7 satellite]. Consumers of the
+    flusher's JSONL (the SLO engine, ``tuplewise doctor``, the future
+    multi-tenant SLO surface) group per-label series by base name, so
+    the round trip is pinned by test.
+
+    Label VALUES may contain ``{``/``}``/``,``/``=`` only if rendered
+    unambiguously; the registry renders str(value), so keep label
+    values simple (ints, short tags) — the same contract Prometheus
+    labels carry."""
+    i = key.find("{")
+    if i < 0 or not key.endswith("}"):
+        return key, None
+    name, inner = key[:i], key[i + 1:-1]
+    labels = {}
+    for part in inner.split(","):
+        k, sep, v = part.partition("=")
+        if not sep:
+            raise ValueError(f"malformed label in metric key {key!r}")
+        labels[k] = v
+    return name, labels
+
+
 class Counter:
     """Monotonic counter: ``c.inc()`` / ``c.inc(5)``; ``c.value``.
 
