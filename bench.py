@@ -458,6 +458,56 @@ def _streaming_events_per_sec(n_events=300_000, budget=64, max_batch=256,
     return rec, base, sync
 
 
+def _multi_tenant_cell(n_events=20_000, tenant_counts=(1, 32, 256),
+                       skew=1.0, budget=16, max_batch=256,
+                       max_inflight=64):
+    """Fleet scaling cell [ISSUE 8 satellite]: the same Zipf-skewed
+    stream replayed through the ``MultiTenantEngine`` at increasing
+    tenant counts — events/s, insert p99 (global + worst tenant),
+    admission counters, and the one-jitted-count witness
+    (``fleet_count_calls`` vs batches) per T. The per-tenant oracle
+    parity guardrail runs on every cell: a fleet that drifts from T
+    independent engines fails the bench, not just a test."""
+    from tuplewise_tpu.serving import (
+        ServingConfig, make_tenant_stream, replay_fleet,
+    )
+
+    cells = {}
+    for T in tenant_counts:
+        scores, labels, tenants = make_tenant_stream(
+            n_events, T, skew=skew, seed=0)
+        cfg = ServingConfig(budget=budget, max_batch=max_batch,
+                            policy="block", flush_timeout_s=0.0005,
+                            compact_every=512)
+        rec = replay_fleet(scores, labels, tenants, config=cfg,
+                           max_inflight=max_inflight, warmup=True)
+        assert (rec.get("tenant_auc_max_abs_err") or 0.0) < 1e-6, (
+            f"fleet parity broke at T={T}: "
+            f"{rec.get('tenant_auc_max_abs_err')}")
+        cells[str(T)] = {
+            "events_per_s": round(rec["events_per_s"], 1),
+            "insert_p99_ms": rec["insert_latency_p99_ms"],
+            "tenant_insert_p99_max_ms": rec["tenant_insert_p99_max_ms"],
+            "tenant_insert_p99_median_ms":
+                rec["tenant_insert_p99_median_ms"],
+            "admission": rec["admission"],
+            "fleet_count_calls": rec["fleet_count_calls"],
+            "batches": rec["batches"],
+            "tenant_auc_max_abs_err": rec["tenant_auc_max_abs_err"],
+        }
+        print(
+            f"[bench] multi_tenant T={T}: "
+            f"{rec['events_per_s']:.0f} ev/s "
+            f"insert p99={rec['insert_latency_p99_ms']:.1f}ms "
+            f"count_calls={rec['fleet_count_calls']} "
+            f"batches={rec['batches']} "
+            f"parity_err={rec['tenant_auc_max_abs_err']:.1e}",
+            file=sys.stderr,
+        )
+    return {"n_events": n_events, "skew": skew, "budget": budget,
+            "cells": cells}
+
+
 def _streaming_main(args):
     import uuid
 
@@ -548,12 +598,25 @@ def _streaming_main(args):
             n_events=args.delta_bench_n, shards=args.delta_bench_shards)
         if cell is not None:
             out["delta_compaction"] = cell
+    if args.tenant_bench_n:
+        # multi-tenant fleet cell [ISSUE 8]: T=1/32/256 (plus
+        # --tenants when given) over the same Zipf stream
+        counts = sorted({1, 32, 256}
+                        | ({args.tenants} if args.tenants > 1 else set()))
+        out["multi_tenant"] = _multi_tenant_cell(
+            n_events=args.tenant_bench_n, tenant_counts=counts,
+            skew=args.tenant_skew, max_batch=args.max_batch,
+            max_inflight=args.max_inflight)
     print(json.dumps(out))
     if args.out:
         rows = [dict(out, stage="bench_streaming")]
         if out.get("delta_compaction"):
             rows.append(dict(out["delta_compaction"],
                              stage="delta_compaction", run_id=run_id))
+        if out.get("multi_tenant"):
+            rows.append(dict(out["multi_tenant"], stage="multi_tenant",
+                             run_id=run_id,
+                             config_digest=out.get("config_digest")))
         with open(args.out, "a", encoding="utf-8") as f:
             for r in rows:
                 f.write(json.dumps(r) + "\n")
@@ -584,6 +647,18 @@ def main():
                          "mode, sharded index driven directly); 0 "
                          "skips it")
     ap.add_argument("--delta-bench-shards", type=int, default=4)
+    ap.add_argument("--tenant-bench-n", type=int, default=20_000,
+                    help="events per multi-tenant fleet cell "
+                         "(events/s + insert p99 at T=1/32/256 through "
+                         "the MultiTenantEngine, per-tenant oracle "
+                         "parity asserted); 0 skips it [ISSUE 8]")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="with --streaming: add this tenant count to "
+                         "the multi_tenant cell's T ladder (fleet "
+                         "load generation; see also replay --tenants)")
+    ap.add_argument("--tenant-skew", type=float, default=1.0,
+                    help="Zipf exponent of the multi-tenant cell's "
+                         "tenant assignment (0 = uniform)")
     ap.add_argument("--out", type=str, default=None,
                     help="with --streaming: also append the record "
                          "(and the delta cell) as JSONL rows, e.g. "

@@ -39,6 +39,18 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
+# Multi-tenant fleet smoke [ISSUE 8]: T=32 tenants over 2 mesh shards
+# through the MultiTenantEngine — per-tenant wins2/AUC bit-identical
+# to 32 independent single-tenant indexes, ONE jitted batched count
+# per coalesced micro-batch, a healthy per-tenant (label-wildcard)
+# SLO verdict with one series per tenant, and typed quota shedding;
+# writes results/multitenant_smoke.jsonl for the CI artifact.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/multitenant_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 # Chaos smoke [ISSUE 3]: a seeded fault schedule (shard death +
 # compactor crash + batcher crash + poison events) through replay;
 # asserts every recovery counter fired and the final AUC is
@@ -97,11 +109,11 @@ PYEOF
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
-# Perf gate [ISSUE 7]: the newest bench_streaming row in the committed
-# results/serving.jsonl vs its history, with noise bands. Warn-then-
-# fail rollout: currently --mode warn (always exit 0, breaches printed
-# + archived in results/perf_gate.jsonl); flip to --mode fail once the
-# bands have soaked against real runner noise.
+# Perf gate [ISSUE 7, flipped to fail in ISSUE 8]: the newest
+# bench_streaming row in the committed results/serving.jsonl vs its
+# history, with noise bands. The warn soak is over — serving.jsonl now
+# carries joinable (run_id + config_digest) history, so a breach is a
+# real regression and fails CI.
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
-    python scripts/perf_gate.py --mode warn
+    python scripts/perf_gate.py --mode fail
 exit $?

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Multi-tenant fleet smoke [ISSUE 8]: T=32 tenants over 2 mesh
+shards, driven end-to-end through the ``MultiTenantEngine``.
+
+Asserts the properties the fleet exists for:
+
+1. **Independence parity** — every tenant's wins2/AUC from the fleet
+   index is BIT-IDENTICAL to a dedicated single-tenant
+   ``ExactAucIndex`` fed the same events (T=32, S=2, coalesced
+   multi-tenant batches).
+2. **One jitted count per coalesced batch** — ``fleet_count_calls``
+   equals the number of micro-batches, not events or tenants
+   (the tenant-axis packing witness).
+3. **Per-tenant SLO verdict** — a label-wildcard objective
+   (``insert_latency_s{tenant=*}``) evaluated live yields a healthy
+   verdict with one series per tenant, and the per-tenant breakdown
+   survives into the record.
+4. **Admission control** — a quota-busting flood is shed typed
+   (``TenantRejectedError``) without touching other tenants' results.
+
+Writes ``results/multitenant_smoke.jsonl`` for the CI artifact.
+Run via scripts/ci.sh (needs the 8-virtual-device XLA flags).
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from tuplewise_tpu.serving import (  # noqa: E402
+    ExactAucIndex, ServingConfig, TenancyConfig, TenantFleetIndex,
+    TenantRejectedError, make_tenant_stream, replay_fleet,
+)
+
+T = 32
+SHARDS = 2
+N_EVENTS = 4000
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "results", "multitenant_smoke.jsonl")
+
+
+def fleet_vs_independent():
+    """Direct index parity: the fleet vs T dedicated engines."""
+    scores, labels, tenants = make_tenant_stream(
+        N_EVENTS, T, skew=1.0, seed=7)
+    fleet = TenantFleetIndex(window=256, compact_every=64,
+                             shards=SHARDS)
+    singles = {}
+    # coalesced multi-tenant batches: chunk the stream, group by tenant
+    chunk = 97
+    for i in range(0, N_EVENTS, chunk):
+        sl = slice(i, min(i + chunk, N_EVENTS))
+        items = []
+        for tid in np.unique(tenants[sl]):
+            m = tenants[sl] == tid
+            items.append((str(tid), scores[sl][m], labels[sl][m]))
+            if tid not in singles:
+                singles[tid] = ExactAucIndex(window=256,
+                                             compact_every=64,
+                                             engine="jax")
+        fleet.apply_inserts(items)
+        for tid, s, l in items:
+            singles[tid].insert_batch(s, l)
+    mismatches = []
+    for tid, idx in singles.items():
+        if fleet.wins2(str(tid)) != idx._wins2 \
+                or fleet.auc(str(tid)) != idx.auc():
+            mismatches.append(str(tid))
+    assert not mismatches, f"fleet/independent mismatch: {mismatches}"
+    return {"tenants": len(singles),
+            "count_calls": fleet.state()["count_calls"],
+            "parity": "bit-identical"}
+
+
+def engine_leg():
+    """Engine-level run with live per-tenant SLO + one-call witness."""
+    scores, labels, tenants = make_tenant_stream(
+        N_EVENTS, T, skew=1.0, seed=11)
+    slo = {"objectives": [
+        {"name": "tenant_insert_p99", "type": "latency",
+         "metric": "insert_latency_s{tenant=*}",
+         "quantile": "p99", "threshold_ms": 10_000},
+        {"name": "no_tenant_rejects", "type": "counter_max",
+         "metric": "tenant_rejected_total", "max": 0},
+    ]}
+    rec = replay_fleet(
+        scores, labels, tenants,
+        config=ServingConfig(window=512, compact_every=128,
+                             max_batch=256, policy="block",
+                             flush_timeout_s=0.001,
+                             mesh_shards=SHARDS),
+        tenancy=TenancyConfig(max_tenants=64, tenant_quota=4096),
+        chunk=2, max_inflight=128, slo_spec=slo)
+    assert rec["events_applied"] == N_EVENTS, rec["events_applied"]
+    err = rec["tenant_auc_max_abs_err"]
+    assert err < 1e-6, f"per-tenant oracle parity broke: {err}"
+    calls, batches = rec["fleet_count_calls"], rec["batches"]
+    assert 0 < calls <= batches, (calls, batches)
+    assert rec["slo"]["healthy"], rec["slo"]
+    series = rec["slo"]["objectives"]["tenant_insert_p99"]["last"][
+        "series"]
+    assert len(series) == T, (len(series), T)
+    return {
+        "events_per_s": round(rec["events_per_s"], 1),
+        "insert_p99_ms": rec["insert_latency_p99_ms"],
+        "tenant_insert_p99_max_ms": rec["tenant_insert_p99_max_ms"],
+        "fleet_count_calls": calls,
+        "batches": batches,
+        "tenant_auc_max_abs_err": err,
+        "slo_healthy": rec["slo"]["healthy"],
+        "slo_series": len(series),
+        "tenancy_report": rec["report"].get("tenancy"),
+    }
+
+
+def admission_leg():
+    """Quota shedding is typed and tenant-attributed."""
+    from tuplewise_tpu.serving import MultiTenantEngine
+
+    rejected = None
+    with MultiTenantEngine(
+            ServingConfig(max_batch=16, flush_timeout_s=0.5),
+            TenancyConfig(max_tenants=4, tenant_quota=2)) as eng:
+        futs = []
+        try:
+            for i in range(64):
+                futs.append(eng.insert("flood", float(i), i % 2))
+        except TenantRejectedError as e:
+            rejected = e.tenant
+        ok = eng.insert("calm", 1.0, 1)
+        assert ok.result(10.0) == 1
+        for f in futs:
+            f.result(10.0)
+    assert rejected == "flood", rejected
+    return {"rejected_tenant": rejected}
+
+
+def main() -> int:
+    rec = {"stage": "multitenant_smoke", "tenants": T,
+           "mesh_shards": SHARDS, "n_events": N_EVENTS}
+    rec["independent_parity"] = fleet_vs_independent()
+    print(f"[multitenant_smoke] index parity OK "
+          f"({rec['independent_parity']})", file=sys.stderr)
+    rec["engine"] = engine_leg()
+    print(f"[multitenant_smoke] engine leg OK ({rec['engine']})",
+          file=sys.stderr)
+    rec["admission"] = admission_leg()
+    print(f"[multitenant_smoke] admission OK ({rec['admission']})",
+          file=sys.stderr)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,9 +25,19 @@ counts.
 
 Modes (the warn-then-fail CI rollout):
 
-* ``--mode warn`` — report breaches, always exit 0 (current ci.sh leg)
-* ``--mode fail`` — exit 1 on breach (flip the leg once the band has
-  soaked against real runner noise)
+* ``--mode warn`` — report breaches, always exit 0
+* ``--mode fail`` — exit 1 on breach (the ci.sh leg since ISSUE 8)
+
+The ISSUE 7→8 warn soak recalibrated the default band: rows in the
+committed history come from DIFFERENT container instances, and
+back-to-back runs of the identical commit on one box spread ~±20%
+in events/s and p99 (measured during the ISSUE 8 flip: 13.9–17.3k
+ev/s, 4.5–7.1ms p99 for the same code). A 15% floor flagged that
+cross-machine noise as regression, so the default ``tolerance_frac``
+is now 0.25 — wide enough for host variance, still far below the
+"30% silent regression" failure mode the gate exists to catch;
+``--tolerance-frac 0.15`` restores the tight band for same-host
+comparisons.
 
 Always writes the verdict row (stage ``perf_gate``) to ``--out`` for
 the CI artifact, and prints it as one stdout JSON line.
@@ -161,9 +171,11 @@ def main(argv=None) -> int:
     ap.add_argument("--stage", type=str, default="bench_streaming")
     ap.add_argument("--mode", choices=["warn", "fail"], default="warn")
     ap.add_argument("--min-history", type=int, default=2)
-    ap.add_argument("--tolerance-frac", type=float, default=0.15,
-                    help="relative band floor (0.15 = 15%% of the "
-                         "history median)")
+    ap.add_argument("--tolerance-frac", type=float, default=0.25,
+                    help="relative band floor (0.25 = 25%% of the "
+                         "history median — calibrated to measured "
+                         "cross-container run noise; use 0.15 for "
+                         "same-host comparisons)")
     ap.add_argument("--mad-k", type=float, default=4.0,
                     help="band widens to k robust-sigmas (1.4826*MAD) "
                          "when the history itself is noisy")

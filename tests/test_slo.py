@@ -218,3 +218,89 @@ class TestHistoryAndDefaults:
             for i in range(5)]
         rep = evaluate_history(DEFAULT_DOCTOR_SPEC, rows)
         assert rep["healthy"]
+
+
+class TestLabelWildcards:
+    """[ISSUE 8 satellite] ``metric{label=*}`` objectives fan out over
+    every matching labeled series — one spec line covers a fleet."""
+
+    def _tenant_registry(self):
+        reg = MetricsRegistry()
+        for t, lat in (("a", 0.001), ("b", 0.2), ("c", 0.003)):
+            h = reg.histogram("insert_latency_s", labels={"tenant": t})
+            for _ in range(8):
+                h.observe(lat)
+        return reg
+
+    def test_latency_wildcard_breaches_on_any_series(self):
+        reg = self._tenant_registry()
+        mon = SloMonitor({"objectives": [
+            {"name": "tp99", "type": "latency",
+             "metric": "insert_latency_s{tenant=*}",
+             "quantile": "p99", "threshold_ms": 50}]}, registry=reg)
+        transitions = mon.observe(reg.snapshot(), 1.0)
+        assert len(transitions) == 1
+        rep = mon.report()
+        series = rep["objectives"]["tp99"]["last"]["series"]
+        assert series["tenant=b"]["breached"]
+        assert not series["tenant=a"]["breached"]
+        assert rep["objectives"]["tp99"]["last"]["series_breached"] == 1
+
+    def test_per_series_breach_gauges_exported(self):
+        reg = self._tenant_registry()
+        mon = SloMonitor({"objectives": [
+            {"name": "tp99", "type": "latency",
+             "metric": "insert_latency_s{tenant=*}",
+             "quantile": "p99", "threshold_ms": 50}]}, registry=reg)
+        mon.observe(reg.snapshot(), 1.0)
+        snap = reg.snapshot()
+        assert snap["slo_breached{objective=tp99,tenant=b}"][
+            "value"] == 1.0
+        assert snap["slo_breached{objective=tp99,tenant=a}"][
+            "value"] == 0.0
+        assert snap["slo_breached{objective=tp99}"]["value"] == 1.0
+
+    def test_counter_max_wildcard(self):
+        m = _m(counters={"tenant_rejected_total{tenant=x}": 0,
+                         "tenant_rejected_total{tenant=y}": 3})
+        mon = SloMonitor({"objectives": [
+            {"name": "rej", "type": "counter_max",
+             "metric": "tenant_rejected_total{tenant=*}", "max": 0}]})
+        mon.observe(m, 0.0)
+        last = mon.report()["objectives"]["rej"]["last"]
+        assert last["series"]["tenant=y"]["breached"]
+        assert not last["series"]["tenant=x"]["breached"]
+
+    def test_wildcard_no_matches_is_healthy(self):
+        mon = SloMonitor({"objectives": [
+            {"name": "tp99", "type": "latency",
+             "metric": "insert_latency_s{tenant=*}",
+             "quantile": "p99", "threshold_ms": 50}]})
+        assert mon.observe(_m(), 0.0) == []
+        assert mon.report()["healthy"]
+
+    def test_error_rate_wildcard_sums_series(self):
+        def snap(err_x, err_y, total):
+            return _m(counters={
+                "tenant_rejected_total{tenant=x}": err_x,
+                "tenant_rejected_total{tenant=y}": err_y,
+                "requests_insert_total": total})
+        spec = {"objectives": [
+            {"name": "avail", "type": "error_rate",
+             "errors": ["tenant_rejected_total{tenant=*}"],
+             "total": "requests_insert_total", "objective": 0.9,
+             "windows": [{"window_s": 1.0, "burn": 1.0}]}]}
+        mon = SloMonitor(spec)
+        mon.observe(snap(0, 0, 100), 0.0)
+        mon.observe(snap(30, 30, 200), 2.0)   # 60 errors / 100 events
+        rep = mon.report()
+        assert rep["objectives"]["avail"]["breaches_total"] == 1
+
+    def test_match_series_exact_labels_respected(self):
+        from tuplewise_tpu.obs.slo import match_series
+
+        m = _m(counters={"c{region=eu,tenant=a}": 1,
+                         "c{region=us,tenant=b}": 2, "c": 3})
+        got = match_series(m, "c{region=eu,tenant=*}")
+        assert len(got) == 1
+        assert got[0][0] == {"tenant": "a"}

@@ -152,7 +152,7 @@ def _emit(results, out):
         write_jsonl(results, out)
 
 
-def _serve_stdin(cfg, chaos=None, obs=None) -> int:
+def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
     """The ``serve`` loop: one JSONL request per stdin line, one JSONL
     response per stdout line (same order); final stats to stderr.
 
@@ -161,19 +161,30 @@ def _serve_stdin(cfg, chaos=None, obs=None) -> int:
     ``--metrics-every``), jax profiling (``--profile-dir``), and the
     flight-recorder dump path (``--flight-out``; with ``--snapshot-dir``
     the engine also auto-dumps next to the snapshots).
+
+    ``tenancy`` [ISSUE 8]: a ``TenancyConfig`` switches the loop onto
+    the multi-tenant fleet engine — requests carry a ``"tenant"``
+    field (default tenant ``"default"``), admission rejections come
+    back typed, and the exit summary gains the fleet block.
     """
     from tuplewise_tpu.obs import MetricsFlusher, service_report
     from tuplewise_tpu.obs.tracing import Tracer
     from tuplewise_tpu.serving import (
         BackpressureError, DeadlineExceededError, EngineClosedError,
-        MicroBatchEngine, PoisonEventError,
+        MicroBatchEngine, MultiTenantEngine, PoisonEventError,
+        TenantRejectedError,
     )
     from tuplewise_tpu.utils.profiling import trace as _jax_trace
 
     tracer = Tracer() if obs is not None and obs.trace_out else None
     flusher = None
     slo_monitor = None
-    with MicroBatchEngine(cfg, chaos=chaos, tracer=tracer) as eng:
+    if tenancy is not None:
+        engine_cm = MultiTenantEngine(cfg, tenancy, chaos=chaos,
+                                      tracer=tracer)
+    else:
+        engine_cm = MicroBatchEngine(cfg, chaos=chaos, tracer=tracer)
+    with engine_cm as eng:
         if obs is not None and getattr(obs, "slo_spec", None):
             # live SLO evaluation [ISSUE 7]: the monitor rides the
             # metrics flusher (observer-only when no --metrics-out)
@@ -203,7 +214,36 @@ def _serve_stdin(cfg, chaos=None, obs=None) -> int:
                 try:
                     req = json.loads(line)
                     op = req["op"]
-                    if op == "insert":
+                    if tenancy is not None:
+                        tid = str(req.get("tenant", "default"))
+                        if op == "insert":
+                            fut = eng.insert(tid, req["score"],
+                                             req["label"])
+                            resp = {"ok": True, "tenant": tid,
+                                    "inserted": int(fut.result(30.0))}
+                        elif op == "score":
+                            ranks = eng.score(
+                                tid, req["score"]).result(30.0)
+                            resp = {"ok": True, "tenant": tid,
+                                    "rank": [None if np.isnan(r)
+                                             else float(r)
+                                             for r in np.atleast_1d(
+                                                 ranks)]}
+                        elif op == "query":
+                            snap = eng.query(tid).result(30.0)
+                            resp = {"ok": True, "tenant": tid,
+                                    "auc_exact": snap.get("auc_exact"),
+                                    "estimate_incomplete":
+                                        snap.get("estimate_incomplete"),
+                                    "state": snap}
+                        elif op == "tenants":
+                            resp = {"ok": True,
+                                    "tenants": eng.fleet.tenants(),
+                                    "fleet": eng.fleet.state()}
+                        else:
+                            resp = {"ok": False,
+                                    "error": f"unknown op {op!r}"}
+                    elif op == "insert":
                         fut = eng.insert(req["score"], req["label"])
                         resp = {"ok": True,
                                 "inserted": int(fut.result(30.0))}
@@ -222,6 +262,9 @@ def _serve_stdin(cfg, chaos=None, obs=None) -> int:
                                 "state": snap.get("index")}
                     else:
                         resp = {"ok": False, "error": f"unknown op {op!r}"}
+                except TenantRejectedError as e:
+                    resp = {"ok": False, "tenant": e.tenant,
+                            "error": f"tenant_rejected: {e}"}
                 except PoisonEventError as e:
                     resp = {"ok": False, "error": f"poison: {e}"}
                 except BackpressureError as e:
@@ -438,7 +481,35 @@ def main(argv=None) -> int:
                             "metrics snapshots; breaches emit "
                             "slo_breach flight events + slo_* gauges, "
                             "verdicts land in the exit summary / "
-                            "replay record")
+                            "replay record. Label wildcards "
+                            "(insert_latency_s{tenant=*}) judge each "
+                            "tenant of a fleet separately [ISSUE 8]")
+        # multi-tenant fleet [ISSUE 8]
+        p.add_argument("--tenants", type=int, default=1,
+                       help="replay: synthetic tenants in the generated "
+                            "stream (> 1 routes through the "
+                            "MultiTenantEngine fleet path); serve: "
+                            "ignored — pass --max-tenants instead")
+        p.add_argument("--tenant-skew", type=float, default=1.0,
+                       help="replay: Zipf exponent of the tenant "
+                            "assignment (0 = uniform; 1 = classic "
+                            "heavy tail)")
+        p.add_argument("--max-tenants", type=int, default=None,
+                       help="serve: run the multi-tenant fleet engine "
+                            "with this tenant cap; requests carry a "
+                            '"tenant" field. replay: fleet tenant cap '
+                            "(default 1024)")
+        p.add_argument("--tenant-quota", type=int, default=64,
+                       help="fleet: max queued requests per tenant "
+                            "(admission control; TenantRejectedError "
+                            "past it)")
+        p.add_argument("--tenant-weight", type=int, default=8,
+                       help="fleet: requests per tenant per fair-"
+                            "scheduling round (deficit round-robin "
+                            "quantum)")
+        p.add_argument("--idle-evict-s", type=float, default=None,
+                       help="fleet: drop tenants idle longer than this "
+                            "(default: never)")
         p.add_argument("--seed", type=int, default=0)
 
     p = sub.add_parser(
@@ -529,7 +600,39 @@ def main(argv=None) -> int:
             from tuplewise_tpu.testing.chaos import FaultInjector
 
             chaos = FaultInjector.from_spec(args.chaos_spec)
+        tenancy = None
+        if (args.max_tenants
+                or (args.cmd == "replay" and args.tenants > 1)):
+            from tuplewise_tpu.serving import TenancyConfig
+
+            tenancy = TenancyConfig(
+                max_tenants=args.max_tenants or 1024,
+                tenant_quota=args.tenant_quota,
+                weight=args.tenant_weight,
+                idle_evict_s=args.idle_evict_s)
         if args.cmd == "replay":
+            if args.tenants > 1:
+                # fleet load generation [ISSUE 8 satellite]: Zipf
+                # tenant assignment through the MultiTenantEngine
+                from tuplewise_tpu.serving import (
+                    make_tenant_stream, replay_fleet,
+                )
+
+                scores, labels, tenants = make_tenant_stream(
+                    args.n_events, args.tenants, skew=args.tenant_skew,
+                    pos_frac=args.pos_frac,
+                    separation=args.separation, seed=args.seed)
+                _emit(
+                    replay_fleet(scores, labels, tenants, config=cfg,
+                                 tenancy=tenancy, chunk=args.chunk,
+                                 chaos=chaos,
+                                 metrics_out=args.metrics_out,
+                                 metrics_every_s=args.metrics_every,
+                                 flight_out=args.flight_out,
+                                 slo_spec=args.slo_spec),
+                    args.out,
+                )
+                return 0
             from tuplewise_tpu.serving import make_stream, replay
 
             scores, labels = make_stream(
@@ -548,7 +651,7 @@ def main(argv=None) -> int:
                 args.out,
             )
             return 0
-        return _serve_stdin(cfg, chaos=chaos, obs=args)
+        return _serve_stdin(cfg, chaos=chaos, obs=args, tenancy=tenancy)
 
     if args.cmd == "variance":
         from tuplewise_tpu.utils.checkpoint import prepare_resume

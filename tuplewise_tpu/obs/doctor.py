@@ -140,6 +140,38 @@ def _metric_value(rows: List[dict], name: str, default=0):
     return rows[-1]["metrics"].get(name, {}).get("value", default)
 
 
+def tenant_breakdown(metrics_rows: List[dict]) -> Optional[dict]:
+    """Per-tenant diagnosis block [ISSUE 8]: every tenant-labeled
+    series in the final snapshot grouped by tenant — insert p99,
+    admission rejections, and any per-tenant SLO breach gauge
+    (``slo_breached{objective=...,tenant=...}``). None when the run
+    was single-tenant (no tenant-labeled metrics)."""
+    if not metrics_rows:
+        return None
+    from collections import defaultdict
+
+    from tuplewise_tpu.utils.profiling import parse_labeled_name
+
+    m = metrics_rows[-1]["metrics"]
+    out: dict = defaultdict(dict)
+    for key, snap in m.items():
+        base, labels = parse_labeled_name(key)
+        if not labels or "tenant" not in labels:
+            continue
+        tid = labels["tenant"]
+        if base == "insert_latency_s":
+            p = snap.get("p99")
+            out[tid]["insert_p99_ms"] = None if p is None else p * 1e3
+            out[tid]["inserts"] = snap.get("count", 0)
+        elif base == "tenant_rejected_total":
+            out[tid]["rejected"] = snap.get("value", 0)
+        elif base == "slo_breached":
+            breached = out[tid].setdefault("slo_breached", [])
+            if snap.get("value"):
+                breached.append(labels.get("objective"))
+    return dict(out) or None
+
+
 def _span_for_trace(spans: List[dict], trace_id) -> Optional[str]:
     """The root-most span name of a trace id (None when the export
     does not carry the trace)."""
@@ -335,6 +367,13 @@ def diagnose(metrics_path: Optional[str] = None,
         "shard_balance_cv": _g("shard_balance_cv"),
     }
     report["health"] = health
+
+    # per-tenant breakdown [ISSUE 8]: fleet runs carry tenant-labeled
+    # metrics; surface them grouped so the doctor answers "WHICH
+    # tenant" in one read (None and omitted for single-tenant runs)
+    tenants = tenant_breakdown(metrics_rows)
+    if tenants is not None:
+        report["tenants"] = tenants
 
     # fault -> breach correlation
     faults = correlate_faults(flight_events, metrics_rows, spans)

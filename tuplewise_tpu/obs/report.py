@@ -120,6 +120,20 @@ def service_report(metrics: dict, chaos=None,
         "major_merges_total": _v(metrics, "major_merges_total"),
     }
     report.update(recovery_counters(metrics))
+    # fleet block [ISSUE 8]: only when the metrics came from a
+    # multi-tenant engine (single-tenant reports keep their key set)
+    if "fleet_count_calls_total" in metrics:
+        report["tenancy"] = {
+            "tenants_live": _v(metrics, "tenants_live"),
+            "tenants_created_total": _v(metrics,
+                                        "tenants_created_total"),
+            "tenants_evicted_total": _v(metrics,
+                                        "tenants_evicted_total"),
+            "tenant_rejected_total": _v(metrics,
+                                        "tenant_rejected_total"),
+            "fleet_count_calls": _v(metrics, "fleet_count_calls_total"),
+            "fleet_compact_aborts": _v(metrics, "fleet_compact_aborts"),
+        }
     if chaos is not None:
         report["chaos"] = chaos.snapshot()
     if flight is not None:

@@ -51,6 +51,15 @@ Objective types:
                     (e.g. ``"queue_size"``) resolved from the config
                     mapping the monitor was built with.
 
+**Label wildcards** [ISSUE 8 satellite]: a metric name may bind any
+label value with ``*`` — ``insert_latency_s{tenant=*}`` evaluates the
+objective against EVERY matching per-tenant series, so one spec line
+covers a whole fleet. The objective breaches when any series does;
+per-series breach gauges (``slo_breached{objective=...,tenant=...}``)
+and a per-series breakdown in the report carry the attribution.
+``error_rate`` objectives may use wildcard counter names too (matching
+series are summed per window).
+
 A breach TRANSITION (ok -> breached) records one ``slo_breach`` flight
 event (trace-id correlated like every flight event) and increments
 ``slo_breaches_total{objective=...}``; the live state is exported as
@@ -82,6 +91,42 @@ class SloSpecError(ValueError):
 
 def _v(m: dict, name: str, default=0):
     return m.get(name, {}).get("value", default)
+
+
+def _is_wild(name) -> bool:
+    return isinstance(name, str) and "=*" in name
+
+
+def match_series(m: dict, pattern: str) -> List[Tuple[dict, dict]]:
+    """Expand a label-wildcard metric pattern against a snapshot
+    [ISSUE 8 satellite]: ``insert_latency_s{tenant=*}`` matches every
+    ``insert_latency_s{tenant=...}`` series. Returns
+    ``[(wild_labels, snapshot_entry)]`` — one per matching series,
+    ``wild_labels`` holding the concrete values the ``*`` bound (the
+    per-series identity the breach gauges are labeled with). Non-``*``
+    labels in the pattern must match exactly."""
+    from tuplewise_tpu.utils.profiling import parse_labeled_name
+
+    base, want = parse_labeled_name(pattern)
+    out = []
+    for key, snap in m.items():
+        b, lab = parse_labeled_name(key)
+        if b != base or lab is None:
+            continue
+        if any(lab.get(k) != v for k, v in want.items() if v != "*"):
+            continue
+        if any(k not in lab for k, v in want.items() if v == "*"):
+            continue
+        out.append(({k: lab[k] for k, v in want.items() if v == "*"},
+                    snap))
+    return out
+
+
+def _sum_v(m: dict, name: str) -> float:
+    """Counter value, summing matching series for wildcard names."""
+    if _is_wild(name):
+        return sum(s.get("value", 0) for _, s in match_series(m, name))
+    return _v(m, name)
 
 
 class _Objective:
@@ -269,6 +314,8 @@ class SloMonitor:
     def _evaluate(self, o: _Objective, m: dict,
                   ts: float) -> Tuple[bool, dict]:
         if o.type == "latency":
+            if _is_wild(o.metric):
+                return self._evaluate_wild(o, m)
             snap = m.get(o.metric, {})
             v = snap.get(o.quantile)
             v_ms = None if v is None else v * 1e3
@@ -276,6 +323,8 @@ class SloMonitor:
                 "value": v_ms, "threshold_ms": o.threshold_ms,
                 "quantile": o.quantile, "metric": o.metric}
         if o.type == "counter_max":
+            if _is_wild(o.metric):
+                return self._evaluate_wild(o, m)
             v = _v(m, o.metric)
             return v > o.max, {"value": v, "max": o.max,
                                "metric": o.metric}
@@ -286,11 +335,15 @@ class SloMonitor:
             if not cap:
                 return False, {"value": None, "capacity": o.capacity,
                                "note": "capacity unresolved"}
+            if _is_wild(o.metric):
+                return self._evaluate_wild(o, m, capacity=float(cap))
             frac = _v(m, o.metric) / float(cap)
             return frac > o.max_fraction, {
                 "value": frac, "max_fraction": o.max_fraction,
                 "capacity": cap, "metric": o.metric}
         # error_rate: counter deltas over each sliding window
+        # (wildcard error/total names sum their matching series, so one
+        # spec line covers a whole labeled fleet)
         budget = 1.0 - float(o.objective)
         burns = {}
         all_exceed = True
@@ -301,8 +354,8 @@ class SloMonitor:
                 # against the oldest snapshot we have (a conservative
                 # shorter window), never against nothing
                 then = self._ring[0][1] if self._ring else m
-            derr = sum(_v(m, e) - _v(then, e) for e in o.errors)
-            dtot = _v(m, o.total) - _v(then, o.total)
+            derr = sum(_sum_v(m, e) - _sum_v(then, e) for e in o.errors)
+            dtot = _sum_v(m, o.total) - _sum_v(then, o.total)
             rate = (derr / dtot) if dtot > 0 else 0.0
             burn = rate / budget if budget > 0 else float("inf")
             burns[f"{w['window_s']:g}s"] = {
@@ -315,6 +368,48 @@ class SloMonitor:
                     default=0.0)
         return all_exceed, {"value": worst, "budget": budget,
                             "windows": burns}
+
+    def _evaluate_wild(self, o: _Objective, m: dict,
+                       capacity: Optional[float] = None
+                       ) -> Tuple[bool, dict]:
+        """Label-wildcard evaluation [ISSUE 8 satellite]: one spec
+        line fans out over every matching labeled series (e.g. every
+        tenant). The objective breaches when ANY series breaches; the
+        detail carries the per-series breakdown the per-series breach
+        gauges and reports are built from."""
+        series = {}
+        worst = None
+        any_breached = False
+        for wild, snap in match_series(m, o.metric):
+            if o.type == "latency":
+                v = snap.get(o.quantile)
+                val = None if v is None else v * 1e3
+                breached = val is not None and val > o.threshold_ms
+            elif o.type == "counter_max":
+                val = snap.get("value", 0)
+                breached = val > o.max
+            else:   # saturation
+                val = snap.get("value", 0) / capacity
+                breached = val > o.max_fraction
+            key = ",".join(f"{k}={wild[k]}" for k in sorted(wild))
+            series[key] = {"value": val, "breached": breached,
+                           "labels": wild}
+            if val is not None and (worst is None or val > worst):
+                worst = val
+            any_breached = any_breached or breached
+        detail = {"value": worst, "metric": o.metric,
+                  "series": series,
+                  "series_breached": sum(
+                      1 for s in series.values() if s["breached"])}
+        if o.type == "latency":
+            detail["threshold_ms"] = o.threshold_ms
+            detail["quantile"] = o.quantile
+        elif o.type == "counter_max":
+            detail["max"] = o.max
+        else:
+            detail["max_fraction"] = o.max_fraction
+            detail["capacity"] = capacity
+        return any_breached, detail
 
     def _at(self, ts: float) -> Optional[dict]:
         """The newest snapshot taken at or before ``ts`` (None when
@@ -336,6 +431,14 @@ class SloMonitor:
         if o.type == "error_rate":
             self.registry.gauge("slo_burn_rate", labels=labels).set(
                 detail.get("value") or 0.0)
+        # per-series breach gauges for wildcard objectives [ISSUE 8]:
+        # `slo_breached{objective=...,tenant=...}` — the fleet surface
+        # a dashboard/doctor groups by tenant
+        for s in detail.get("series", {}).values():
+            self.registry.gauge(
+                "slo_breached",
+                labels=dict(labels, **s["labels"])).set(
+                1.0 if s["breached"] else 0.0)
         c = self.registry.counter("slo_breaches_total", labels=labels)
         c.inc(o.breaches_total - c.value)
 

@@ -52,6 +52,20 @@ unchanged — re-ships only the rows whose content actually changed,
 reassembling the block from the surviving per-device shards
 (``bytes_h2d_saved`` counts what the naive full re-ship would have
 cost) [ISSUE 5 satellite].
+
+**Tenant axis** [ISSUE 8]: the bucket ladder generalizes to a FLEET of
+independent sorted runs — thousands of per-tenant statistics
+multiplexed over one mesh. ``place_tenant_pack`` packs every tenant's
+sorted run into ONE shared padded ``[S, T_bucket, cap]`` device buffer
+(tenant t's slice s in row ``[s, t]``, +inf padded; per-tenant lengths
+live on the host — the +inf padding makes device-side length masks
+unnecessary for counting, because a finite query's insertion index
+never crosses the padding). ``tenant_count_fn`` is the tenant-axis
+count kernel: a vmapped per-row ``searchsorted`` over BOTH class
+packs and both query blocks under ONE psum, so one jitted call serves
+a whole coalesced batch of tenants' queries. Compile shapes follow
+the ``(T_bucket, cap, q_bucket)`` ladder — powers of two in each axis
+— never the live tenant count or the batch's tenant mix.
 """
 
 from __future__ import annotations
@@ -591,3 +605,148 @@ def sharded_major_merge(mesh, base_dev, cap_base: int,
         return outs[0], plan.cap_out
     return (_merge_assemble_fn(mesh, chunk, parts)(*outs),
             plan.cap_out)
+
+
+# --------------------------------------------------------------------- #
+# tenant axis [ISSUE 8]                                                  #
+# --------------------------------------------------------------------- #
+
+_MIN_TENANT_BUCKET = 8
+
+
+def tenant_bucket(n: int, min_bucket: int = _MIN_TENANT_BUCKET) -> int:
+    """Tenant-row bucket: power of two >= n (the T axis of the
+    (T_bucket, cap, q_bucket) compile-shape ladder)."""
+    return next_bucket(max(n, 1), min_bucket=min_bucket)
+
+
+def place_tenant_pack(mesh, runs: Sequence[np.ndarray], t_bucket: int,
+                      dtype, *, metrics=None,
+                      chaos=None) -> Tuple[object, int, int]:
+    """Pack a fleet of sorted runs into one shared padded device buffer.
+
+    ``runs[t]`` is tenant slot t's sorted host run (may be empty; slots
+    past ``len(runs)`` are empty rows). With a mesh, the pack is
+    ``[S, t_bucket, cap]`` — tenant t's contiguous slice s (its own
+    ``per_t = ceil(n_t / S)`` split) in row ``[s, t]`` — placed one
+    leading-row per device via the same NamedSharding the base runs
+    use; without a mesh it is a single-device ``[t_bucket, cap]``
+    block. ``cap`` is the bucket of the LARGEST per-shard slice, shared
+    by every tenant (the shared-buffer trade: one compile shape for the
+    whole fleet, padding proportional to the biggest tenant). All
+    padding is +inf, so finite queries count exactly without masks.
+
+    Returns ``(device_array, cap, shipped_bytes)``; bytes are credited
+    to ``bytes_h2d`` like every other placement. ``chaos`` fires the
+    ``place_base`` hook (a raise here exercises the fleet's
+    retry/heal path).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if chaos is not None:
+        chaos.fire("place_base")
+    S = mesh_size(mesh) if mesh is not None else 1
+    pers = [-(-len(r) // S) if len(r) else 0 for r in runs]
+    cap = next_bucket(max(pers, default=1) or 1)
+    itemsize = np.dtype(dtype).itemsize
+    block = np.full((S, t_bucket, cap), np.inf, dtype=dtype)
+    for t, r in enumerate(runs):
+        per = pers[t]
+        for s in range(S):
+            chunk = r[s * per:(s + 1) * per]
+            if len(chunk):
+                block[s, t, : len(chunk)] = chunk
+    shipped = block.nbytes
+    if mesh is None:
+        dev = jnp.asarray(block[0])
+    else:
+        from tuplewise_tpu.backends.mesh_backend import row_sharding
+
+        dev = jax.device_put(jnp.asarray(block), row_sharding(mesh))
+    _count_bytes(metrics, shipped, 0)
+    return dev, cap, shipped
+
+
+@functools.lru_cache(maxsize=None)
+def tenant_count_fn(mesh, t_bucket: int, cap_pos: int, cap_neg: int,
+                    q_bucket: int):
+    """Jitted tenant-axis fleet count [ISSUE 8]: ONE call, ONE psum.
+
+    ``(pos_pack [S, T, cap_pos], neg_pack [S, T, cap_neg],
+    q_vs_neg [T, qb], q_vs_pos [T, qb]) -> (less_n, leq_n, less_p,
+    leq_p)`` — each ``[T, qb]`` replicated int counts. Row t of each
+    query block is tenant slot t's padded queries; a vmapped per-row
+    ``searchsorted`` against the tenant's own rows keeps every tenant's
+    counts independent, and the single tuple psum sums the per-shard
+    slices. Serving a whole coalesced multi-tenant micro-batch is one
+    dispatch of this function, however many tenants it touches.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+
+    def _rows(pack, q, side):
+        return jax.vmap(
+            lambda row, qq: jnp.searchsorted(row, qq, side=side))(pack, q)
+
+    def body(pos, neg, qn, qp):
+        # local packs arrive as [1, T, cap]
+        out = (_rows(neg[0], qn, "left"), _rows(neg[0], qn, "right"),
+               _rows(pos[0], qp, "left"), _rows(pos[0], qp, "right"))
+        return lax.psum(out, axes)
+
+    @jax.jit
+    def f(pos, neg, qn, qp):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axes), P(axes), P(), P()),
+            out_specs=(P(), P(), P(), P()), check_vma=False,
+        )(pos, neg, qn, qp)
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def tenant_count_local_fn(t_bucket: int, cap_pos: int, cap_neg: int,
+                          q_bucket: int):
+    """Single-device twin of :func:`tenant_count_fn` (no mesh): packs
+    are ``[T, cap]`` blocks, outputs identical."""
+    import jax
+    import jax.numpy as jnp
+
+    def _rows(pack, q, side):
+        return jax.vmap(
+            lambda row, qq: jnp.searchsorted(row, qq, side=side))(pack, q)
+
+    @jax.jit
+    def f(pos, neg, qn, qp):
+        return (_rows(neg, qn, "left"), _rows(neg, qn, "right"),
+                _rows(pos, qp, "left"), _rows(pos, qp, "right"))
+
+    return f
+
+
+def tenant_pack_counts(mesh, pos_pack, cap_pos: int, neg_pack,
+                       cap_neg: int, t_bucket: int,
+                       q_vs_neg: np.ndarray, q_vs_pos: np.ndarray,
+                       dtype, chaos=None):
+    """Dispatch one fleet count: padded ``[t_bucket, qb]`` query blocks
+    against both class packs. Returns four ``[t_bucket, qb]`` int64
+    arrays ``(less_n, leq_n, less_p, leq_p)``. ``chaos`` fires the
+    ``sharded_count`` hook — the same point a dead mesh device
+    surfaces at, so fleet healing is driven by the same specs as the
+    single-tenant index [ISSUE 8].
+    """
+    if chaos is not None:
+        chaos.fire("sharded_count")
+    qb = q_vs_neg.shape[1]
+    if mesh is None:
+        fn = tenant_count_local_fn(t_bucket, cap_pos, cap_neg, qb)
+    else:
+        fn = tenant_count_fn(mesh, t_bucket, cap_pos, cap_neg, qb)
+    out = fn(pos_pack, neg_pack, q_vs_neg, q_vs_pos)
+    return tuple(np.asarray(o).astype(np.int64) for o in out)

@@ -39,6 +39,16 @@ traffic is a *stream* of scored events, so this package adds:
                                  errors: ``EngineClosedError``,
                                  ``PoisonEventError``,
                                  ``DeadlineExceededError`` [ISSUE 3].
+* ``tenancy``                  — the multi-tenant serving fleet
+                                 [ISSUE 8]: ``MultiTenantEngine`` /
+                                 ``TenantFleetIndex`` multiplex
+                                 thousands of per-tenant statistics
+                                 over one mesh through shared packed
+                                 device buffers (one jitted count per
+                                 coalesced multi-tenant batch),
+                                 admission control + weighted-fair
+                                 scheduling (``TenantRejectedError``),
+                                 per-tenant windows/streams/WAL/SLOs.
 """
 
 from tuplewise_tpu.serving.engine import (
@@ -50,8 +60,19 @@ from tuplewise_tpu.serving.engine import (
     ServingConfig,
 )
 from tuplewise_tpu.serving.index import ExactAucIndex
-from tuplewise_tpu.serving.replay import make_stream, replay
+from tuplewise_tpu.serving.replay import (
+    make_stream,
+    make_tenant_stream,
+    replay,
+    replay_fleet,
+)
 from tuplewise_tpu.serving.streaming import StreamingIncompleteU
+from tuplewise_tpu.serving.tenancy import (
+    MultiTenantEngine,
+    TenancyConfig,
+    TenantFleetIndex,
+    TenantRejectedError,
+)
 
 __all__ = [
     "BackpressureError",
@@ -59,9 +80,15 @@ __all__ = [
     "EngineClosedError",
     "ExactAucIndex",
     "MicroBatchEngine",
+    "MultiTenantEngine",
     "PoisonEventError",
     "ServingConfig",
     "StreamingIncompleteU",
+    "TenancyConfig",
+    "TenantFleetIndex",
+    "TenantRejectedError",
     "make_stream",
+    "make_tenant_stream",
     "replay",
+    "replay_fleet",
 ]

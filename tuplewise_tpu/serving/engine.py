@@ -87,7 +87,16 @@ class BackpressureError(RuntimeError):
 class EngineClosedError(RuntimeError):
     """The engine shut down before (or while) the request was applied —
     the typed outcome every queued/blocked producer sees at close()
-    instead of a hang. [ISSUE 3]"""
+    instead of a hang. [ISSUE 3]
+
+    ``tenant`` carries the request's tenant id when one was tagged
+    [ISSUE 8 bugfix]: a fleet shutdown must tell each caller WHOSE
+    request died — a generic closed error loses the attribution the
+    multi-tenant retry/alerting path routes on."""
+
+    def __init__(self, msg: str, tenant: Optional[str] = None):
+        super().__init__(msg)
+        self.tenant = tenant
 
 
 class PoisonEventError(ValueError):
@@ -183,9 +192,10 @@ class ServingConfig:
 
 class _Request:
     __slots__ = ("kind", "scores", "labels", "future", "t_enqueue",
-                 "span")
+                 "span", "tenant")
 
-    def __init__(self, kind: str, scores, labels, span=None):
+    def __init__(self, kind: str, scores, labels, span=None,
+                 tenant=None):
         self.kind = kind
         self.scores = scores
         self.labels = labels
@@ -193,6 +203,9 @@ class _Request:
         self.t_enqueue = time.perf_counter()
         # per-request trace root [ISSUE 6]; None when tracing is off
         self.span = span
+        # optional tenant tag [ISSUE 8]: carried so failure paths can
+        # attribute the loss to the owning tenant
+        self.tenant = tenant
 
 
 class MicroBatchEngine:
@@ -307,18 +320,22 @@ class MicroBatchEngine:
     # ------------------------------------------------------------------ #
     # request side                                                       #
     # ------------------------------------------------------------------ #
-    def submit(self, kind: str, scores=None, labels=None) -> Future:
+    def submit(self, kind: str, scores=None, labels=None,
+               tenant=None) -> Future:
         """Enqueue one request; returns its Future.
 
         insert: scores + labels (scalars or arrays) — resolves to the
           number of events inserted.
         score: scores — resolves to fractional ranks vs negatives.
         query: no payload — resolves to a state snapshot dict.
+        tenant: optional tag carried through the request lifecycle;
+          failure paths (close, deadline) attribute the loss to it
+          [ISSUE 8].
         """
         if kind not in _KINDS:
             raise ValueError(f"unknown request kind {kind!r}")
         if self._closed:
-            raise EngineClosedError("engine is closed")
+            raise EngineClosedError("engine is closed", tenant=tenant)
         if kind == "insert":
             scores, labels = self._validate_insert(scores, labels)
         elif kind == "score":
@@ -329,7 +346,7 @@ class MicroBatchEngine:
         span = None
         if self.tracer is not None:
             span = self.tracer.start(f"request.{kind}", parent=None)
-        req = _Request(kind, scores, labels, span=span)
+        req = _Request(kind, scores, labels, span=span, tenant=tenant)
         if span is not None:
             # anchor the root to t_enqueue, the same reading every
             # stage boundary measures from — child stage spans then
@@ -389,14 +406,14 @@ class MicroBatchEngine:
             self._poison("insert: non-finite label(s) rejected")
         return scores, labels
 
-    def insert(self, scores, labels) -> Future:
-        return self.submit("insert", scores, labels)
+    def insert(self, scores, labels, tenant=None) -> Future:
+        return self.submit("insert", scores, labels, tenant=tenant)
 
-    def score(self, scores) -> Future:
-        return self.submit("score", scores)
+    def score(self, scores, tenant=None) -> Future:
+        return self.submit("score", scores, tenant=tenant)
 
-    def query(self) -> Future:
-        return self.submit("query")
+    def query(self, tenant=None) -> Future:
+        return self.submit("query", tenant=tenant)
 
     def flush(self, timeout: Optional[float] = 30.0) -> dict:
         """Barrier: wait until everything enqueued so far is applied."""
@@ -467,13 +484,20 @@ class MicroBatchEngine:
         EngineClosedError. Draining is what UNBLOCKS producers stuck in
         a full-queue put under the "block" policy — their requests then
         land here (or in close()'s final drain / their own post-put
-        check) and fail typed instead of hanging."""
-        exc = EngineClosedError(
-            "engine closed before the request was applied")
+        check) and fail typed instead of hanging. Tenant-tagged
+        requests fail with the tenant id IN the error [ISSUE 8
+        bugfix]: before this, a fleet caller multiplexing tenants over
+        one engine got an unattributable generic error at shutdown."""
         r = first
         while True:
             if r is not None and not r.future.done():
-                r.future.set_exception(exc)
+                if r.tenant is not None:
+                    r.future.set_exception(EngineClosedError(
+                        "engine closed before the request was applied "
+                        f"(tenant={r.tenant})", tenant=r.tenant))
+                else:
+                    r.future.set_exception(EngineClosedError(
+                        "engine closed before the request was applied"))
                 if self.tracer is not None and r.span is not None:
                     self.tracer.finish(r.span)
                     r.span = None

@@ -65,7 +65,6 @@ import numpy as np
 from tuplewise_tpu.utils.checkpoint import (
     check_config, load_checkpoint, save_checkpoint,
 )
-from tuplewise_tpu.utils.rng import capture_np_rng, restore_np_rng
 
 SNAPSHOT_FILE = "snapshot.npz"
 WAL_FILE = "events.wal"
@@ -87,10 +86,15 @@ class EventLog:
         self._f = open(path, "a", encoding="utf-8")
 
     def append(self, seq: int, scores: np.ndarray,
-               labels: np.ndarray) -> None:
+               labels: np.ndarray, tenant: Optional[str] = None) -> None:
         rec = {"seq": int(seq),
                "s": [float(x) for x in scores],
                "l": [int(bool(x)) for x in labels]}
+        if tenant is not None:
+            # tenant namespacing [ISSUE 8]: one physical log, logically
+            # namespaced by the tenant tag (thousands of tenants cannot
+            # each own a file descriptor); replay groups by it
+            rec["t"] = str(tenant)
         self._f.write(json.dumps(rec) + "\n")
         # flush past the process boundary: survives SIGKILL; fsync
         # additionally survives power loss (wal_fsync="batch")
@@ -137,9 +141,10 @@ class EventLog:
         return sorted(out)
 
     @staticmethod
-    def replay(path: str) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
-        """Yield (seq, scores, labels) entries; a torn final line (the
-        crash interrupted the write) ends the replay cleanly."""
+    def replay_records(path: str) -> Iterator[dict]:
+        """Yield raw WAL records (``seq``/``s``/``l`` plus the optional
+        tenant tag ``t``); a torn final line (the crash interrupted the
+        write) ends the replay cleanly."""
         if not os.path.exists(path):
             return
         with open(path, "r", encoding="utf-8") as f:
@@ -148,20 +153,34 @@ class EventLog:
                 if not line:
                     continue
                 try:
-                    rec = json.loads(line)
+                    yield json.loads(line)
                 except json.JSONDecodeError:
                     return
-                yield (int(rec["seq"]),
-                       np.asarray(rec["s"], dtype=np.float64),
-                       np.asarray(rec["l"], dtype=bool))
+
+    @staticmethod
+    def replay(path: str) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
+        """Yield (seq, scores, labels) entries (tenant tags dropped)."""
+        for rec in EventLog.replay_records(path):
+            yield (int(rec["seq"]),
+                   np.asarray(rec["s"], dtype=np.float64),
+                   np.asarray(rec["l"], dtype=bool))
+
+    @staticmethod
+    def replay_all_records(path: str) -> Iterator[dict]:
+        """Raw records from sealed segments (seq order) then the live
+        log — the full surviving tail regardless of where a crash
+        landed."""
+        for _, seg in EventLog.segments(path):
+            yield from EventLog.replay_records(seg)
+        yield from EventLog.replay_records(path)
 
     @staticmethod
     def replay_all(path: str) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
-        """Replay sealed segments (seq order) then the live log — the
-        full surviving tail regardless of where a crash landed."""
-        for _, seg in EventLog.segments(path):
-            yield from EventLog.replay(seg)
-        yield from EventLog.replay(path)
+        """(seq, scores, labels) over segments then the live log."""
+        for rec in EventLog.replay_all_records(path):
+            yield (int(rec["seq"]),
+                   np.asarray(rec["s"], dtype=np.float64),
+                   np.asarray(rec["l"], dtype=bool))
 
 
 def _compat_config(config) -> dict:
@@ -181,6 +200,10 @@ def capture_snapshot_state(engine) -> Tuple[dict, dict]:
     disk) and return (extra, cfg) for a writer to persist. Runs on the
     batcher thread under the engine lock, so the capture is a
     consistent cut at the current event seq."""
+    # lazy: utils.rng imports jax, and this module now rides the
+    # numpy-only import path via serving.tenancy [ISSUE 8]
+    from tuplewise_tpu.utils.rng import capture_np_rng
+
     extra = {}
     cfg = dict(_compat_config(engine.config))
     idx = engine.index
@@ -248,6 +271,8 @@ def restore_snapshot(directory: str, engine) -> Optional[int]:
     the snapshot's event seq, or None when no snapshot exists. Raises
     if the stored config is incompatible with the engine's (resuming a
     different experiment would silently corrupt the statistic)."""
+    from tuplewise_tpu.utils.rng import restore_np_rng
+
     ck = load_checkpoint(os.path.join(directory, SNAPSHOT_FILE))
     if ck is None:
         return None
@@ -350,24 +375,40 @@ class RecoveryManager:
         self._wal = self._open_wal()
         self._wal.truncate()
 
+    # the engine-shape seam [ISSUE 8]: a manager subclass (the
+    # multi-tenant fleet's) swaps what a snapshot captures/restores and
+    # how a WAL record is re-applied, while the WAL/segment/async-writer
+    # protocol stays ONE implementation
+    def _capture(self, engine) -> Tuple[dict, dict]:
+        return capture_snapshot_state(engine)
+
+    def _restore(self, engine) -> Optional[int]:
+        return restore_snapshot(self.directory, engine)
+
+    def _replay_entry(self, engine, rec: dict) -> None:
+        scores = np.asarray(rec["s"], dtype=np.float64)
+        labels = np.asarray(rec["l"], dtype=bool)
+        if engine.index is not None:
+            engine.index.insert_batch(scores, labels)
+        engine.streaming.extend(scores, labels)
+
     def recover(self, engine) -> int:
         """Snapshot + tail replay (sealed segments, then the live
         log); returns the recovered event seq."""
-        seq = restore_snapshot(self.directory, engine) or 0
-        for s0, scores, labels in EventLog.replay_all(self._wal_path()):
-            if s0 < seq:
+        seq = self._restore(engine) or 0
+        for rec in EventLog.replay_all_records(self._wal_path()):
+            if int(rec["seq"]) < seq:
                 continue    # already inside the snapshot
-            if engine.index is not None:
-                engine.index.insert_batch(scores, labels)
-            engine.streaming.extend(scores, labels)
-            seq = s0 + len(scores)
+            self._replay_entry(engine, rec)
+            seq = int(rec["seq"]) + len(rec["s"])
         self._seq = seq
         self._wal = self._open_wal()
         return seq
 
     # ------------------------------------------------------------------ #
-    def record(self, scores: np.ndarray, labels: np.ndarray) -> None:
-        self._wal.append(self._seq, scores, labels)
+    def record(self, scores: np.ndarray, labels: np.ndarray,
+               tenant: Optional[str] = None) -> None:
+        self._wal.append(self._seq, scores, labels, tenant=tenant)
         self._seq += len(scores)
         self._since_snapshot += len(scores)
 
@@ -391,7 +432,7 @@ class RecoveryManager:
 
         seq = self._seq
         with maybe_span(self.tracer, "snapshot.capture", seq=seq):
-            extra, cfg = capture_snapshot_state(engine)
+            extra, cfg = self._capture(engine)
             self._wal.seal(seq)
         if self.flight is not None:
             self.flight.record("wal_seal", seq=seq)
@@ -402,7 +443,7 @@ class RecoveryManager:
     def snapshot(self, engine) -> None:
         """Synchronous capture + write (close path, and the
         ``snapshot_async=False`` escape hatch)."""
-        extra, cfg = capture_snapshot_state(engine)
+        extra, cfg = self._capture(engine)
         write_snapshot(self.directory, seq=self._seq, extra=extra,
                        cfg=cfg)
         if self.flight is not None:

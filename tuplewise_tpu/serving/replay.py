@@ -22,7 +22,8 @@ from tuplewise_tpu.obs.report import (
     stage_attribution as _stage_attr, stage_p99_ms as _stage_p99_ms,
 )
 from tuplewise_tpu.serving.engine import (
-    BackpressureError, MicroBatchEngine, PoisonEventError, ServingConfig,
+    BackpressureError, EngineClosedError, MicroBatchEngine,
+    PoisonEventError, ServingConfig,
 )
 
 
@@ -34,6 +35,33 @@ def make_stream(n_events: int, pos_frac: float = 0.5,
     labels = rng.random(n_events) < pos_frac
     scores = rng.standard_normal(n_events) + separation * labels
     return scores, labels
+
+
+def make_tenant_stream(n_events: int, n_tenants: int, skew: float = 1.0,
+                       pos_frac: float = 0.5, separation: float = 1.0,
+                       seed: int = 0):
+    """Multi-tenant synthetic stream [ISSUE 8 satellite]: the Gaussian
+    score stream plus a per-event tenant assignment drawn from a Zipf
+    law — tenant rank k gets probability ∝ ``1/k**skew`` (``skew=0`` =
+    uniform), the classic heavy-tailed production shape where a few hot
+    tenants dominate and a long tail stays nearly idle. Returns
+    ``(scores, labels, tenant_ids)`` with string tenant ids
+    ``"t0".."t{n-1}"`` in rank (hotness) order."""
+    if n_tenants < 1:
+        raise ValueError(f"n_tenants must be >= 1: {n_tenants}")
+    if skew < 0:
+        raise ValueError(f"skew must be >= 0: {skew}")
+    rng = np.random.default_rng(seed)
+    labels = rng.random(n_events) < pos_frac
+    scores = rng.standard_normal(n_events) + separation * labels
+    if n_tenants == 1:
+        ks = np.zeros(n_events, dtype=np.int64)
+    else:
+        p = np.arange(1, n_tenants + 1, dtype=np.float64) ** (-skew)
+        p /= p.sum()
+        ks = rng.choice(n_tenants, size=n_events, p=p)
+    tenants = np.asarray([f"t{k}" for k in ks])
+    return scores, labels, tenants
 
 
 def replay(scores, labels, config: Optional[ServingConfig] = None,
@@ -315,4 +343,233 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             np.asarray(tail_s[~tail_l], dtype=np.float32 if cfg.engine ==
                        "jax" else np.float64))
         rec["auc_abs_err"] = abs(rec["auc_exact"] - rec["auc_oracle"])
+    return rec
+
+
+def replay_fleet(scores, labels, tenants,
+                 config: Optional[ServingConfig] = None,
+                 tenancy=None, chunk: int = 1,
+                 max_inflight: Optional[int] = None, chaos=None,
+                 slo_spec=None, metrics_out: Optional[str] = None,
+                 metrics_every_s: float = 1.0,
+                 flight_out: Optional[str] = None,
+                 run_id: Optional[str] = None, warmup: bool = False,
+                 oracle_check: bool = True, **overrides) -> dict:
+    """Replay a tenant-assigned stream through a
+    :class:`~tuplewise_tpu.serving.tenancy.MultiTenantEngine` and
+    return the fleet measurement record [ISSUE 8].
+
+    ``warmup=True`` replays once through a throwaway engine first so
+    the timed run measures the steady state — the tenant-axis count
+    kernels compile per (T_bucket, cap, q_bucket) ladder shape, and a
+    long-lived fleet never sees those compiles again (same contract
+    as :func:`replay`).
+
+    The fleet twin of :func:`replay`: one insert request per ``chunk``
+    consecutive events (each tagged with its event's tenant — chunks
+    split at tenant boundaries so every request is single-tenant),
+    bounded in-flight submission, admission-control counters
+    (``TenantRejectedError`` shed events are recorded per tenant), a
+    per-tenant insert-latency breakdown, and a per-tenant
+    oracle-parity guardrail: every tenant's final exact AUC is
+    compared against the batch oracle on exactly that tenant's
+    admitted (windowed) events — the fleet MUST look like T
+    independent single-tenant services, statistic-wise.
+
+    ``slo_spec`` rides a metrics-flusher observer exactly as in
+    :func:`replay`; label-wildcard objectives
+    (``insert_latency_s{tenant=*}``) give the record's ``slo`` block a
+    per-tenant breakdown.
+    """
+    from tuplewise_tpu.serving.tenancy import (
+        MultiTenantEngine, TenancyConfig, TenantRejectedError,
+    )
+
+    scores = np.asarray(scores, dtype=np.float64).ravel()
+    labels = np.asarray(labels).ravel().astype(bool)
+    tenants = np.asarray(tenants).ravel()
+    n = len(scores)
+    if len(tenants) != n:
+        raise ValueError(
+            f"tenants/scores length mismatch: {len(tenants)} vs {n}")
+    cfg = config or ServingConfig(**overrides)
+    ten_cfg = tenancy if tenancy is not None else TenancyConfig()
+    injector = None
+    if chaos is not None:
+        from tuplewise_tpu.testing.chaos import FaultInjector
+
+        injector = FaultInjector.from_spec(chaos)
+    if warmup:
+        replay_fleet(scores, labels, tenants, config=cfg,
+                     tenancy=ten_cfg, chunk=chunk,
+                     max_inflight=max_inflight, oracle_check=False)
+    admitted = np.ones(n, dtype=bool)
+    rejected = poison_rejected = tenant_rejected = 0
+    futures = []
+    flusher = None
+    slo_monitor = None
+    with MultiTenantEngine(cfg, ten_cfg, chaos=injector) as eng:
+        if slo_spec is not None:
+            from tuplewise_tpu.obs.slo import SloMonitor
+
+            slo_monitor = SloMonitor(
+                slo_spec, registry=eng.metrics, flight=eng.flight,
+                context=dataclasses.asdict(cfg))
+        if metrics_out or slo_monitor is not None:
+            from tuplewise_tpu.obs.metrics_export import MetricsFlusher
+
+            every = metrics_every_s
+            if slo_monitor is not None:
+                short = slo_monitor.spec.shortest_window_s
+                if short:
+                    every = min(every, max(short / 4.0, 0.05))
+            flusher = MetricsFlusher(
+                eng.metrics, metrics_out or None, every_s=every,
+                meta={"stage": "replay_fleet"}, config=cfg,
+                observers=([slo_monitor.observe_row]
+                           if slo_monitor is not None else ())).start()
+        t0 = time.perf_counter()
+        i = 0
+        while i < n:
+            # a request is single-tenant: cut the chunk at the next
+            # tenant boundary (the engine coalesces ACROSS tenants)
+            j = min(i + chunk, n)
+            tid = tenants[i]
+            while j > i + 1 and not np.all(tenants[i:j] == tid):
+                j -= 1
+            sub = scores[i:j]
+            if injector is not None:
+                sub, _ = injector.poison_batch(i, sub)
+            try:
+                futures.append(eng.insert(tid, sub, labels[i:j]))
+            except PoisonEventError:
+                poison_rejected += j - i
+                admitted[i:j] = False
+            except TenantRejectedError:
+                tenant_rejected += j - i
+                admitted[i:j] = False
+            except BackpressureError:
+                rejected += j - i
+                admitted[i:j] = False
+            if max_inflight and len(futures) >= max_inflight:
+                try:
+                    futures[len(futures) - max_inflight].result(
+                        timeout=60.0)
+                except (BackpressureError, EngineClosedError):
+                    pass
+            i = j
+        dropped = 0
+        for f in futures:
+            try:
+                f.result(timeout=60.0)
+            except BackpressureError:
+                dropped += 1
+        wall = time.perf_counter() - t0
+        if flusher is not None:
+            flusher.stop()
+        stats = eng.stats()
+        live = eng.fleet.tenants()
+        tenant_stats = {t: eng.tenant_stats(t) for t in live}
+    flight_counts = eng.flight.counts()
+    if flight_out:
+        eng.flight.dump_to(flight_out)
+
+    m = stats["metrics"]
+    ins = m.get("insert_latency_s", {})
+    applied = m["events_total"]["value"]
+
+    def _ms(snap, q):
+        v = snap.get(q)
+        return None if v is None else v * 1e3
+
+    # per-tenant insert p99 from the labeled histograms [ISSUE 8]
+    from tuplewise_tpu.utils.profiling import parse_labeled_name
+
+    tenant_p99 = {}
+    for key, snap in m.items():
+        base, lab = parse_labeled_name(key)
+        if base == "insert_latency_s" and lab and "tenant" in lab:
+            p = snap.get("p99")
+            if p is not None:
+                tenant_p99[lab["tenant"]] = p * 1e3
+    p99s = sorted(tenant_p99.values())
+    rec = {
+        "n_events": n,
+        "n_tenants": int(len(np.unique(tenants))),
+        "tenants_live": stats["tenants_live"],
+        "events_applied": int(applied),
+        "events_rejected": int(rejected),
+        "events_tenant_rejected": int(tenant_rejected),
+        "events_poison_rejected": int(poison_rejected),
+        "requests_dropped": int(dropped),
+        "wall_s": wall,
+        "events_per_s": applied / wall if wall > 0 else None,
+        "insert_latency_p50_ms": _ms(ins, "p50"),
+        "insert_latency_p95_ms": _ms(ins, "p95"),
+        "insert_latency_p99_ms": _ms(ins, "p99"),
+        "tenant_insert_p99_ms": (tenant_p99 if len(tenant_p99) <= 64
+                                 else None),
+        "tenant_insert_p99_max_ms": (p99s[-1] if p99s else None),
+        "tenant_insert_p99_median_ms": (
+            p99s[len(p99s) // 2] if p99s else None),
+        "admission": {
+            "tenant_rejected_total": m.get(
+                "tenant_rejected_total", {}).get("value", 0),
+            "rejected_total": m.get("rejected_total", {}).get("value", 0),
+            "dropped_total": m.get("dropped_total", {}).get("value", 0),
+            "tenants_created_total": m.get(
+                "tenants_created_total", {}).get("value", 0),
+            "tenants_evicted_total": m.get(
+                "tenants_evicted_total", {}).get("value", 0),
+        },
+        "batches": m["batches_total"]["value"],
+        "fleet_count_calls": m.get(
+            "fleet_count_calls_total", {}).get("value", 0),
+        "flight_events": flight_counts,
+        "fleet": stats["fleet"],
+        "config": {
+            "budget": cfg.budget, "window": cfg.window,
+            "max_batch": cfg.max_batch, "queue_size": cfg.queue_size,
+            "policy": cfg.policy, "mesh_shards": cfg.mesh_shards,
+            "chunk": chunk, "max_tenants": ten_cfg.max_tenants,
+            "tenant_quota": ten_cfg.tenant_quota,
+            "weight": ten_cfg.weight,
+        },
+    }
+    from tuplewise_tpu.obs.metrics_export import config_digest
+
+    rec["config_digest"] = config_digest(cfg)
+    if run_id is not None:
+        rec["run_id"] = run_id
+    rec["report"] = service_report(m, chaos=injector, slo=slo_monitor)
+    if slo_monitor is not None:
+        rec["slo"] = slo_monitor.report()
+    if metrics_out:
+        rec["metrics_out"] = metrics_out
+    if injector is not None:
+        rec["faults"] = dict(recovery_counters(m),
+                             chaos=injector.snapshot())
+
+    # per-tenant oracle parity [ISSUE 8 acceptance]: each tenant's
+    # exact AUC vs the batch oracle over ITS admitted (windowed)
+    # events — the fleet must be indistinguishable from T independent
+    # single-tenant engines
+    if oracle_check and rejected == 0 and dropped == 0 \
+            and tenant_rejected == 0:
+        from tuplewise_tpu.models.metrics import auc_score
+
+        worst = 0.0
+        for tid in np.unique(tenants):
+            mask = admitted & (tenants == tid)
+            ts_, tl_ = scores[mask], labels[mask]
+            if cfg.window is not None:
+                ts_, tl_ = ts_[-cfg.window:], tl_[-cfg.window:]
+            got = (tenant_stats.get(str(tid)) or {}).get("auc_exact")
+            if got is None or not tl_.any() or tl_.all():
+                continue
+            want = auc_score(
+                np.asarray(ts_[tl_], dtype=np.float32),
+                np.asarray(ts_[~tl_], dtype=np.float32))
+            worst = max(worst, abs(got - want))
+        rec["tenant_auc_max_abs_err"] = worst
     return rec

@@ -1,0 +1,1403 @@
+"""Multi-tenant serving fleet [ISSUE 8]: thousands of per-tenant
+statistics multiplexed over ONE mesh.
+
+The paper prices distributed tuplewise estimation for ONE statistic;
+production traffic at the north-star scale is millions of users ≈
+thousands of independent statistics (per-user/per-cohort AUC,
+per-region windows). Spinning up one ``MicroBatchEngine`` +
+``ExactAucIndex`` per tenant would mean one batcher thread, one device
+placement, and one compiled-kernel family PER TENANT — none of which
+scales past a few dozen. This module multiplexes the fleet:
+
+* :class:`TenantFleetIndex` — the tenant-axis generalization of the
+  sharded exact-AUC index. Every tenant's sorted base runs (both
+  classes) live in ONE shared padded ``[S, T_bucket, cap]`` device
+  buffer per class side (``parallel.sharded_counts.place_tenant_pack``)
+  and ONE jitted vmapped searchsorted + psum
+  (``tenant_count_fn``) serves a whole coalesced batch of tenants'
+  queries — insert counts, eviction counts, and score ranks for every
+  tenant the micro-batch touched, in one dispatch. Compile shapes
+  follow the ``(T_bucket, cap, q_bucket)`` bucket ladder (powers of
+  two per axis), never the live tenant count, so a fleet of 3 or 3000
+  tenants reuses the same handful of compiled kernels. Host-side each
+  tenant keeps the LSM discipline of the single-tenant index — small
+  insert buffer, tombstones, arrival log, exact integer ``wins2`` —
+  so every tenant's AUC is bit-identical to a dedicated
+  ``ExactAucIndex`` fed the same events (the parity the tests pin at
+  S=1/2/4 and under chaos heal).
+
+* :class:`MultiTenantEngine` — the fleet request path: per-tenant FIFO
+  queues with admission control (per-tenant quotas + a fleet-wide
+  tenant cap, typed :class:`TenantRejectedError`) and a
+  starvation-free weighted-fair (deficit-round-robin) drain order, so
+  one hot tenant cannot monopolize the batcher. Tenant lifecycle:
+  create-on-first-request, explicit drop, idle eviction
+  (``idle_evict_s``). Per-tenant sliding windows, per-tenant
+  incomplete-U streams (seeded per tenant, deterministically), and
+  per-tenant observability via metric labels
+  (``insert_latency_s{tenant=}``, ``tenant_rejected_total{tenant=}``)
+  that the SLO layer's label-wildcard objectives
+  (``insert_latency_s{tenant=*}``) judge per tenant.
+
+* :class:`FleetRecoveryManager` — crash safety for the whole fleet
+  through the existing WAL/snapshot machinery: WAL records carry a
+  tenant tag (logical namespacing — one physical log, thousands of
+  tenants cannot each own a file descriptor), snapshots capture every
+  tenant's containers + wins2 + reservoir/RNG state, and recovery is
+  per-tenant bit-identical across SIGKILL (same contract the
+  single-tenant engine has carried since ISSUE 3).
+
+Failure model: the host is authoritative for every tenant's runs — the
+packed device buffers are a pure cache. Device loss heals through the
+shared ``parallel.self_heal.MeshHealer`` (probe → re-place the packs →
+bounded retry), and a crashed compaction aborts cleanly (containers
+untouched, wins2 never touched by compaction) and retries at the next
+trigger — so per-tenant results stay bit-identical to T independent
+single-tenant engines under any chaos schedule the single-tenant
+index survives.
+
+Deliberately NOT per-tenant (documented trade): delta compaction and
+background builds. Tenants are small by construction (the fleet's
+reason to exist), so a tenant compaction is an O(tenant) host splice —
+the delta machinery's O(buffer)-shipping advantage only pays at the
+single-giant-statistic scale, and per-tenant placement cost is bounded
+by the pack rebuild the compaction already triggers.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.tracing import maybe_span
+from tuplewise_tpu.serving.engine import (
+    BackpressureError, EngineClosedError, PoisonEventError, ServingConfig,
+)
+from tuplewise_tpu.serving.index import _remove_sorted, _splice_merge
+from tuplewise_tpu.serving.recovery import RecoveryManager
+from tuplewise_tpu.serving.streaming import StreamingIncompleteU
+from tuplewise_tpu.utils.checkpoint import check_config
+from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+
+class TenantRejectedError(RuntimeError):
+    """Admission control shed this request at the edge [ISSUE 8]:
+    per-tenant queue quota exceeded, or the fleet is at its tenant
+    cap. Carries the tenant id — multi-tenant shedding must be
+    attributable."""
+
+    def __init__(self, msg: str, tenant: Optional[str] = None):
+        super().__init__(msg)
+        self.tenant = tenant
+
+
+@dataclasses.dataclass(frozen=True)
+class TenancyConfig:
+    """Fleet-level knobs layered over a :class:`ServingConfig`.
+
+    Args:
+      max_tenants: hard cap on live tenants; creating past it raises
+        :class:`TenantRejectedError` (admission control, not a crash).
+      tenant_quota: max queued (unapplied) requests per tenant; the
+        per-tenant arm of admission control — one flooding tenant
+        exhausts its own quota, not the shared queue.
+      weight: requests a tenant may contribute per fair-scheduling
+        round (deficit round-robin quantum). Every pending tenant is
+        served up to ``weight`` requests before any tenant is served
+        again — starvation-free by construction.
+      idle_evict_s: drop tenants idle longer than this (None = never).
+        Eviction frees the tenant's slot; its pack row goes stale
+        harmlessly (rows are per-tenant independent) and is rebuilt
+        when the slot is reused.
+      min_tenant_bucket: floor of the T_bucket compile-shape ladder.
+      tenant_metrics: export per-tenant labeled metrics
+        (``insert_latency_s{tenant=}`` etc.). On by default; a
+        100k-tenant deployment would bound label cardinality here.
+    """
+
+    max_tenants: int = 1024
+    tenant_quota: int = 64
+    weight: int = 8
+    idle_evict_s: Optional[float] = None
+    min_tenant_bucket: int = 8
+    tenant_metrics: bool = True
+
+    def __post_init__(self):
+        if self.max_tenants < 1:
+            raise ValueError(f"max_tenants must be >= 1: {self.max_tenants}")
+        if self.tenant_quota < 1:
+            raise ValueError(
+                f"tenant_quota must be >= 1: {self.tenant_quota}")
+        if self.weight < 1:
+            raise ValueError(f"weight must be >= 1: {self.weight}")
+        if self.idle_evict_s is not None and self.idle_evict_s <= 0:
+            raise ValueError(
+                f"idle_evict_s must be > 0: {self.idle_evict_s}")
+        if self.min_tenant_bucket < 1:
+            raise ValueError(
+                f"min_tenant_bucket must be >= 1: {self.min_tenant_bucket}")
+
+
+def tenant_seed(base_seed: int, tid: str) -> int:
+    """Deterministic per-tenant RNG seed (stable across processes —
+    ``hash()`` is salted per interpreter, so it cannot be used here)."""
+    h = hashlib.sha256(f"{base_seed}:{tid}".encode("utf-8")).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+class _TenantStat:
+    """One tenant's host-authoritative exact-AUC state: the
+    single-tenant index's LSM containers, minus the device fields (the
+    fleet packs own those) and the delta tier (tenants are small)."""
+
+    __slots__ = ("tid", "slot", "pos_base", "neg_base", "pos_buf",
+                 "neg_buf", "pos_tomb", "neg_tomb", "log", "wins2",
+                 "n_evicted", "n_compactions", "last_active")
+
+    def __init__(self, tid: str, slot: int, dtype):
+        self.tid = tid
+        self.slot = slot
+        self.pos_base = np.empty(0, dtype=dtype)
+        self.neg_base = np.empty(0, dtype=dtype)
+        self.pos_buf: List[float] = []
+        self.neg_buf: List[float] = []
+        self.pos_tomb: List[float] = []
+        self.neg_tomb: List[float] = []
+        self.log: Deque[Tuple[float, bool]] = collections.deque()
+        self.wins2 = 0              # exact: Python int never overflows
+        self.n_evicted = 0
+        self.n_compactions = 0
+        self.last_active = time.monotonic()
+
+    def side(self, pos: bool):
+        if pos:
+            return self.pos_base, self.pos_buf, self.pos_tomb
+        return self.neg_base, self.neg_buf, self.neg_tomb
+
+    def size(self, pos: bool) -> int:
+        base, buf, tomb = self.side(pos)
+        return len(base) + len(buf) - len(tomb)
+
+    def values(self, pos: bool) -> np.ndarray:
+        """Current class multiset (oracle/debug path, O(n))."""
+        base, buf, tomb = self.side(pos)
+        out = np.sort(np.concatenate(
+            [base, np.asarray(buf, dtype=base.dtype)]), kind="stable")
+        return _remove_sorted(out, list(tomb))
+
+
+class _Pack:
+    """One class side's shared device buffer + its placement geometry."""
+
+    __slots__ = ("dev", "cap", "t_bucket", "dirty")
+
+    def __init__(self):
+        self.dev = None
+        self.cap = 0
+        self.t_bucket = 0
+        self.dirty = True
+
+
+class TenantFleetIndex:
+    """Exact per-tenant AUC for a fleet, counted through shared packs.
+
+    Args:
+      window: per-tenant sliding window (arrivals); None = unbounded.
+      compact_every: per-tenant buffer/tombstone size triggering that
+        tenant's compaction (host splice + pack re-place).
+      shards: None = single-device packs; an int S >= 1 shards every
+        tenant's runs over an S-device mesh (the per-tenant contiguous
+        slices of ``place_tenant_pack``); counts stay bit-identical at
+        every S — additivity over partitions is per-tenant-row here.
+      mesh: an existing 1-D mesh (overrides ``shards``).
+      metrics / chaos / tracer / flight: the usual observability and
+        fault-injection hooks; the count path fires ``sharded_count``,
+        placements fire ``place_base``, compactions fire
+        ``compactor_build`` — the same points the single-tenant stack
+        uses, so one chaos spec drives both.
+    """
+
+    def __init__(self, window: Optional[int] = None,
+                 compact_every: int = 512,
+                 shards: Optional[int] = None, mesh=None,
+                 metrics=None, chaos=None, shard_retries: int = 3,
+                 retry_backoff_s: float = 0.02,
+                 probe_timeout_s: float = 5.0,
+                 min_tenant_bucket: int = 8,
+                 tracer=None, flight=None):
+        if window is not None and window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        if compact_every < 1:
+            raise ValueError(f"compact_every must be >= 1: {compact_every}")
+        if mesh is not None:
+            shards = int(np.prod(mesh.devices.shape))
+        if shards is not None and shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.window = window
+        self.compact_every = compact_every
+        self.shards = shards
+        self.min_tenant_bucket = min_tenant_bucket
+        self.dtype = np.float32
+        self.chaos = chaos
+        self.shard_retries = shard_retries
+        self.tracer = tracer
+        self.flight = flight
+        self._mesh = mesh
+        if shards is not None and mesh is None:
+            from tuplewise_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(shards)
+        self._slots: List[Optional[_TenantStat]] = []
+        self._free: List[int] = []
+        self._by_tid: Dict[str, _TenantStat] = {}
+        self._pos_pack = _Pack()
+        self._neg_pack = _Pack()
+        self._lock = threading.RLock()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # ONE jitted batched count per coalesced multi-tenant batch —
+        # this counter is the assertable witness [ISSUE 8 acceptance]
+        self._c_count_calls = self.metrics.counter(
+            "fleet_count_calls_total")
+        self._c_count_tenants = self.metrics.counter(
+            "fleet_count_tenant_queries_total")
+        self._c_compactions = self.metrics.counter("compactions_total")
+        self._c_compact_aborts = self.metrics.counter(
+            "fleet_compact_aborts")
+        self._h_pause = self.metrics.histogram("compaction_pause_s")
+        self._g_tenants = self.metrics.gauge("fleet_tenants")
+        self._g_mesh = self.metrics.gauge("mesh_width")
+        self._g_mesh.set(shards if shards is not None else 0)
+        self._c_heal_exhausted = self.metrics.counter(
+            "heal_exhausted_total")
+        self.metrics.counter("reshard_events")
+        self.metrics.counter("shard_retries_total")
+        self.metrics.histogram("recovery_time_s")
+        self.last_compactor_error = None
+        self._healer = None
+        if shards is not None:
+            from tuplewise_tpu.parallel.self_heal import Backoff, MeshHealer
+
+            self._healer = MeshHealer(
+                self._mesh, chaos=chaos,
+                probe_timeout_s=probe_timeout_s, metrics=self.metrics,
+                backoff=Backoff(base_s=retry_backoff_s, cap_s=1.0),
+                tracer=tracer, flight=flight)
+
+    # ------------------------------------------------------------------ #
+    # tenant lifecycle                                                   #
+    # ------------------------------------------------------------------ #
+    @property
+    def n_tenants(self) -> int:
+        with self._lock:
+            return len(self._by_tid)
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return list(self._by_tid)
+
+    def has(self, tid: str) -> bool:
+        with self._lock:
+            return tid in self._by_tid
+
+    def create(self, tid: str) -> _TenantStat:
+        """Create (or return) a tenant. A reused slot marks the packs
+        dirty — its row still holds the evicted tenant's values; a
+        fresh slot inside the current T_bucket is already an all-+inf
+        (empty) row, so no re-place is needed until data lands."""
+        with self._lock:
+            st = self._by_tid.get(tid)
+            if st is not None:
+                return st
+            if self._free:
+                slot = self._free.pop()
+                self._pos_pack.dirty = True
+                self._neg_pack.dirty = True
+            else:
+                slot = len(self._slots)
+                self._slots.append(None)
+            st = _TenantStat(tid, slot, self.dtype)
+            self._slots[slot] = st
+            self._by_tid[tid] = st
+            self._g_tenants.set(len(self._by_tid))
+            if self.flight is not None:
+                self.flight.record("tenant_created", tenant=tid,
+                                   slot=slot)
+            return st
+
+    def drop(self, tid: str) -> bool:
+        """Remove a tenant; its slot is recycled. The stale pack row
+        is harmless (per-tenant rows are independent and the slot is
+        only queried again after a dirty re-place)."""
+        with self._lock:
+            st = self._by_tid.pop(tid, None)
+            if st is None:
+                return False
+            self._slots[st.slot] = None
+            self._free.append(st.slot)
+            self._g_tenants.set(len(self._by_tid))
+            if self.flight is not None:
+                self.flight.record("tenant_evicted", tenant=tid,
+                                   slot=st.slot, events=len(st.log))
+            return True
+
+    def idle_tenants(self, idle_s: float) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [tid for tid, st in self._by_tid.items()
+                    if now - st.last_active > idle_s]
+
+    # ------------------------------------------------------------------ #
+    # the ONE-call fleet count                                           #
+    # ------------------------------------------------------------------ #
+    def _t_bucket(self) -> int:
+        from tuplewise_tpu.parallel.sharded_counts import tenant_bucket
+
+        return tenant_bucket(len(self._slots),
+                             min_bucket=self.min_tenant_bucket)
+
+    def _ensure_packs(self) -> None:
+        """(Re)place dirty packs from the host-authoritative runs
+        (caller holds the lock; runs inside the heal retry loop so a
+        placement onto a dead device heals like a count would)."""
+        from tuplewise_tpu.parallel.sharded_counts import place_tenant_pack
+
+        tb = self._t_bucket()
+        for pack, pos in ((self._pos_pack, True), (self._neg_pack, False)):
+            if not pack.dirty and pack.dev is not None \
+                    and pack.t_bucket == tb:
+                continue
+            runs = [(s.pos_base if pos else s.neg_base)
+                    if s is not None else np.empty(0, dtype=self.dtype)
+                    for s in self._slots]
+            with maybe_span(self.tracer, "fleet.place_pack",
+                            side="pos" if pos else "neg",
+                            tenants=len(self._by_tid)):
+                pack.dev, pack.cap, _ = place_tenant_pack(
+                    self._mesh, runs, tb, self.dtype,
+                    metrics=self.metrics, chaos=self.chaos)
+            pack.t_bucket = tb
+            pack.dirty = False
+
+    def _on_heal(self, healer) -> None:
+        """Adopt the (possibly narrower) healed mesh and rebuild the
+        packs — a pure cache rebuild from the host runs."""
+        self._mesh = healer.mesh
+        self.shards = healer.n_workers
+        self._g_mesh.set(self.shards)
+        self._pos_pack.dirty = True
+        self._neg_pack.dirty = True
+
+    def _fleet_base_counts(self, q_vs_neg: List[np.ndarray],
+                           q_vs_pos: List[np.ndarray],
+                           slots: List[int]):
+        """Base-run counts for every tenant's queries in ONE jitted
+        call. ``q_vs_neg[i]`` / ``q_vs_pos[i]`` are tenant
+        ``slots[i]``'s query values against the neg / pos pack; returns
+        per-input (less, leq) int64 arrays. Caller holds the lock."""
+        from tuplewise_tpu.parallel.self_heal import HealExhaustedError
+        from tuplewise_tpu.parallel.sharded_counts import (
+            next_bucket, tenant_pack_counts,
+        )
+
+        longest = max((len(q) for q in q_vs_neg + q_vs_pos), default=0)
+        if longest == 0:
+            z = [np.zeros(0, dtype=np.int64) for _ in slots]
+            return list(z), list(z), list(z), list(z)
+        qb = next_bucket(longest)
+        tb = self._t_bucket()
+        qn = np.zeros((tb, qb), dtype=self.dtype)
+        qp = np.zeros((tb, qb), dtype=self.dtype)
+        for i, slot in enumerate(slots):
+            if len(q_vs_neg[i]):
+                qn[slot, : len(q_vs_neg[i])] = q_vs_neg[i]
+            if len(q_vs_pos[i]):
+                qp[slot, : len(q_vs_pos[i])] = q_vs_pos[i]
+
+        def attempt():
+            self._ensure_packs()
+            return tenant_pack_counts(
+                self._mesh, self._pos_pack.dev, self._pos_pack.cap,
+                self._neg_pack.dev, self._neg_pack.cap, tb, qn, qp,
+                self.dtype, chaos=self.chaos)
+
+        try:
+            with maybe_span(self.tracer, "fleet.count",
+                            tenants=len(slots)):
+                if self._healer is not None:
+                    out = self._healer.run(attempt,
+                                           retries=self.shard_retries,
+                                           on_heal=self._on_heal)
+                else:
+                    out = attempt()
+        except HealExhaustedError as e:
+            self._c_heal_exhausted.inc()
+            if self.flight is not None:
+                self.flight.record("heal_exhausted", error=repr(e))
+                self.flight.auto_dump()
+            raise
+        self._c_count_calls.inc()
+        self._c_count_tenants.inc(len(slots))
+        less_n, leq_n, less_p, leq_p = out
+        ln, qn_out, lp, qp_out = [], [], [], []
+        for i, slot in enumerate(slots):
+            kn, kp = len(q_vs_neg[i]), len(q_vs_pos[i])
+            ln.append(less_n[slot, :kn])
+            qn_out.append(leq_n[slot, :kn])
+            lp.append(less_p[slot, :kp])
+            qp_out.append(leq_p[slot, :kp])
+        return ln, qn_out, lp, qp_out
+
+    # ------------------------------------------------------------------ #
+    # host-side exact arithmetic (mirrors ExactAucIndex._counts)         #
+    # ------------------------------------------------------------------ #
+    def _host_adjust(self, q: np.ndarray, base_less: np.ndarray,
+                     base_leq: np.ndarray, buf: List[float],
+                     tomb: List[float]):
+        """(less, eq) vs the CURRENT class multiset: device base counts
+        corrected by the host buffer (+) and tombstones (−) — the same
+        signed-multiset additivity the single-tenant index uses, so
+        the integers are identical."""
+        less = base_less.astype(np.int64, copy=True)
+        eq = (base_leq - base_less).astype(np.int64)
+        for vals, sign in ((buf, 1), (tomb, -1)):
+            if not vals:
+                continue
+            arr = np.sort(np.asarray(vals, dtype=self.dtype))
+            l2 = np.searchsorted(arr, q, side="left").astype(np.int64)
+            r2 = np.searchsorted(arr, q, side="right").astype(np.int64)
+            less += sign * l2
+            eq += sign * (r2 - l2)
+        return less, eq
+
+    @staticmethod
+    def _cross2_arrays(p: np.ndarray, n: np.ndarray) -> int:
+        if len(p) == 0 or len(n) == 0:
+            return 0
+        ns = np.sort(n)
+        less = np.searchsorted(ns, p, side="left").astype(np.int64)
+        leq = np.searchsorted(ns, p, side="right").astype(np.int64)
+        return int(2 * less.sum() + (leq - less).sum())
+
+    # ------------------------------------------------------------------ #
+    # mutation                                                           #
+    # ------------------------------------------------------------------ #
+    def insert_batch(self, tid: str, scores, labels) -> int:
+        """Single-tenant convenience over :meth:`apply_inserts`."""
+        return self.apply_inserts([(tid, scores, labels)])[0]
+
+    def apply_inserts(
+        self, items: List[Tuple[str, np.ndarray, np.ndarray]],
+    ) -> List[int]:
+        """Insert one coalesced batch per tenant — every tenant's
+        new-vs-old counts AND window-eviction counts ride ONE jitted
+        fleet count. Items must name distinct tenants (the engine
+        coalesces per tenant first); returns events inserted per item.
+
+        Exactness: wins2 is a pure integer function of each tenant's
+        admitted event sequence (pair sets are order- and
+        batching-free), so per-tenant results are bit-identical to a
+        dedicated single-tenant index fed the same events — the parity
+        the fleet tests pin.
+        """
+        with self._lock:
+            return self._apply_inserts_locked(items)
+
+    def _apply_inserts_locked(self, items) -> List[int]:
+        plans = []
+        seen = set()
+        for tid, scores, labels in items:
+            st = self._by_tid.get(tid)
+            if st is None:
+                st = self.create(tid)
+            if st.slot in seen:
+                raise ValueError(
+                    f"duplicate tenant {tid!r} in one apply — coalesce "
+                    "per tenant first")
+            seen.add(st.slot)
+            scores = np.asarray(scores, dtype=self.dtype).ravel()
+            labels = np.asarray(labels).ravel().astype(bool)
+            if scores.shape != labels.shape:
+                raise ValueError(
+                    f"scores/labels length mismatch: {scores.shape} vs "
+                    f"{labels.shape}")
+            if len(scores) and not np.all(np.isfinite(scores)):
+                raise ValueError("scores must be finite")
+            p_new = scores[labels]
+            n_new = scores[~labels]
+            # window-eviction plan: the oldest overflow arrivals of
+            # (current log ++ this batch, in order) leave the window —
+            # values known BEFORE the device call, so their base counts
+            # share it with the insert queries
+            p_out: List[float] = []
+            n_out: List[float] = []
+            n_evict = 0
+            if self.window is not None:
+                n_evict = max(0, len(st.log) + len(scores) - self.window)
+            if n_evict:
+                import itertools
+
+                pool = itertools.chain(
+                    st.log, zip(scores.tolist(), labels.tolist()))
+                for v, is_pos in itertools.islice(pool, n_evict):
+                    (p_out if is_pos else n_out).append(v)
+            p_out_arr = np.asarray(p_out, dtype=self.dtype)
+            n_out_arr = np.asarray(n_out, dtype=self.dtype)
+            plans.append((st, scores, labels, p_new, n_new,
+                          p_out_arr, n_out_arr, n_evict))
+        ln, lqn, lp, lqp = self._fleet_base_counts(
+            [np.concatenate([p[3], p[5]]) for p in plans],
+            [np.concatenate([p[4], p[6]]) for p in plans],
+            [p[0].slot for p in plans])
+        out = []
+        for i, plan in enumerate(plans):
+            out.append(self._fold_plan(plan, ln[i], lqn[i], lp[i], lqp[i]))
+        for plan in plans:
+            st = plan[0]
+            if (len(st.pos_buf) + len(st.neg_buf) >= self.compact_every
+                    or len(st.pos_tomb) + len(st.neg_tomb)
+                    >= self.compact_every):
+                self._compact_tenant(st)
+        return out
+
+    def _fold_plan(self, plan, less_n, leq_n, less_p, leq_p) -> int:
+        """Apply one tenant's insert + eviction with host-exact
+        integer arithmetic (lock held). The device supplied base
+        counts for [p_new ++ p_out] vs neg and [n_new ++ n_out] vs
+        pos; buffers/tombstones adjust on the host at the right
+        container state (pre-insert for the insert term, post-insert
+        for the eviction term — exactly the single-tenant order)."""
+        (st, scores, labels, p_new, n_new, p_out, n_out, n_evict) = plan
+        kp, kn = len(p_new), len(n_new)
+        # --- insert: new-vs-old (containers pre-insert) --------------- #
+        less, eq = self._host_adjust(p_new, less_n[:kp], leq_n[:kp],
+                                     st.neg_buf, st.neg_tomb)
+        d = int(2 * less.sum() + eq.sum())
+        less2, eq2 = self._host_adjust(n_new, less_p[:kn], leq_p[:kn],
+                                       st.pos_buf, st.pos_tomb)
+        greater = st.size(True) - less2 - eq2
+        d += int(2 * greater.sum() + eq2.sum())
+        d += self._cross2_arrays(p_new, n_new)
+        st.wins2 += d
+        st.pos_buf.extend(p_new.tolist())
+        st.neg_buf.extend(n_new.tolist())
+        for s, is_pos in zip(scores.tolist(), labels.tolist()):
+            st.log.append((s, is_pos))
+        # --- eviction: inclusion-exclusion (containers post-insert) --- #
+        if n_evict:
+            less, eq = self._host_adjust(p_out, less_n[kp:], leq_n[kp:],
+                                         st.neg_buf, st.neg_tomb)
+            d = int(2 * less.sum() + eq.sum())
+            less2, eq2 = self._host_adjust(n_out, less_p[kn:], leq_p[kn:],
+                                           st.pos_buf, st.pos_tomb)
+            greater = st.size(True) - less2 - eq2
+            d += int(2 * greater.sum() + eq2.sum())
+            d -= self._cross2_arrays(p_out, n_out)
+            st.wins2 -= d
+            for _ in range(n_evict):
+                v, is_pos = st.log.popleft()
+                buf = st.pos_buf if is_pos else st.neg_buf
+                try:
+                    buf.remove(v)
+                except ValueError:
+                    (st.pos_tomb if is_pos else st.neg_tomb).append(v)
+            st.n_evicted += n_evict
+        st.last_active = time.monotonic()
+        return len(scores)
+
+    def _compact_tenant(self, st: _TenantStat) -> None:
+        """Fold a tenant's buffers/tombstones into its sorted bases
+        and mark the packs for re-placement (lock held). A chaos-
+        injected crash aborts CLEANLY: containers untouched, wins2
+        never touched by compaction, retried at the next trigger."""
+        if self.chaos is not None:
+            try:
+                self.chaos.fire("compactor_build")
+            except Exception as e:   # noqa: BLE001 — injected crash
+                self._c_compact_aborts.inc()
+                self.last_compactor_error = repr(e)
+                if self.flight is not None:
+                    self.flight.record("compaction_abort",
+                                       tenant=st.tid, error=repr(e))
+                return
+        t0 = time.perf_counter()
+        with maybe_span(self.tracer, "fleet.compact", tenant=st.tid):
+            for pos in (True, False):
+                base, buf, tomb = st.side(pos)
+                if not buf and not tomb:
+                    continue
+                merged = _remove_sorted(
+                    _splice_merge(base, np.sort(
+                        np.asarray(buf, dtype=self.dtype))),
+                    list(tomb))
+                if pos:
+                    st.pos_base, st.pos_buf, st.pos_tomb = merged, [], []
+                    self._pos_pack.dirty = True
+                else:
+                    st.neg_base, st.neg_buf, st.neg_tomb = merged, [], []
+                    self._neg_pack.dirty = True
+        st.n_compactions += 1
+        self._c_compactions.inc()
+        self._h_pause.observe(time.perf_counter() - t0)
+        if self.flight is not None:
+            self.flight.record("compaction", tier="tenant",
+                               tenant=st.tid,
+                               base_events=len(st.pos_base)
+                               + len(st.neg_base))
+
+    # ------------------------------------------------------------------ #
+    # queries                                                            #
+    # ------------------------------------------------------------------ #
+    def apply_scores(
+        self, items: List[Tuple[str, np.ndarray]],
+    ) -> List[np.ndarray]:
+        """Fractional ranks vs each tenant's negatives for a coalesced
+        multi-tenant score batch — ONE jitted fleet count."""
+        with self._lock:
+            plans = []
+            for tid, q in items:
+                st = self._by_tid.get(tid)
+                if st is None:
+                    st = self.create(tid)
+                q = np.asarray(q, dtype=self.dtype).ravel()
+                plans.append((st, q))
+            empty = np.zeros(0, dtype=self.dtype)
+            ln, lqn, _, _ = self._fleet_base_counts(
+                [q for _, q in plans], [empty for _ in plans],
+                [st.slot for st, _ in plans])
+            out = []
+            for i, (st, q) in enumerate(plans):
+                n_neg = st.size(False)
+                if n_neg == 0:
+                    out.append(np.full(len(q), np.nan))
+                    continue
+                less, eq = self._host_adjust(q, ln[i], lqn[i],
+                                             st.neg_buf, st.neg_tomb)
+                out.append((less + 0.5 * eq) / float(n_neg))
+                st.last_active = time.monotonic()
+            return out
+
+    def wins2(self, tid: str) -> int:
+        with self._lock:
+            return self._by_tid[tid].wins2
+
+    def auc(self, tid: str) -> Optional[float]:
+        with self._lock:
+            st = self._by_tid.get(tid)
+            if st is None:
+                return None
+            np_, nn = st.size(True), st.size(False)
+            if np_ == 0 or nn == 0:
+                return None
+            return st.wins2 / (2.0 * np_ * nn)
+
+    def oracle_values(self, tid: str) -> Tuple[np.ndarray, np.ndarray]:
+        with self._lock:
+            st = self._by_tid[tid]
+            return st.values(True), st.values(False)
+
+    def tenant_state(self, tid: str) -> Optional[dict]:
+        with self._lock:
+            st = self._by_tid.get(tid)
+            if st is None:
+                return None
+            return {
+                "tenant": tid,
+                "n_pos": st.size(True),
+                "n_neg": st.size(False),
+                "n_events": len(st.log),
+                "auc": self.auc(tid),
+                "n_compactions": st.n_compactions,
+                "n_evicted": st.n_evicted,
+            }
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "tenants": len(self._by_tid),
+                "slots": len(self._slots),
+                "t_bucket": self._t_bucket(),
+                "shards": self.shards,
+                "window": self.window,
+                "pack_caps": {"pos": self._pos_pack.cap,
+                              "neg": self._neg_pack.cap},
+                "count_calls": self._c_count_calls.value,
+                "last_compactor_error": self.last_compactor_error,
+            }
+
+
+# --------------------------------------------------------------------- #
+# fleet request path                                                     #
+# --------------------------------------------------------------------- #
+
+class _FleetRequest:
+    __slots__ = ("kind", "tenant", "scores", "labels", "future",
+                 "t_enqueue", "span")
+
+    def __init__(self, kind: str, tenant: str, scores, labels,
+                 span=None):
+        self.kind = kind
+        self.tenant = tenant
+        self.scores = scores
+        self.labels = labels
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.span = span
+
+
+class MultiTenantEngine:
+    """Micro-batched fleet engine: per-tenant queues, admission
+    control, weighted-fair scheduling, one batcher thread, one mesh.
+
+    The single-tenant :class:`~tuplewise_tpu.serving.engine.
+    MicroBatchEngine` semantics hold per tenant — per-tenant event
+    order, exact per-tenant AUC, per-tenant windows/streams — while
+    the shared resources (queue capacity, batcher, device packs) are
+    governed fleet-wide:
+
+    * **admission** — ``submit`` raises :class:`TenantRejectedError`
+      when the tenant's queued-request quota or the fleet tenant cap is
+      exceeded (typed, counted globally and per tenant), and the
+      global ``queue_size``/``policy`` backpressure applies on top.
+    * **fair scheduling** — the batcher drains per-tenant FIFOs in
+      deficit-round-robin order (up to ``TenancyConfig.weight``
+      requests per tenant per round), so every pending tenant is
+      served each round regardless of one tenant's flood.
+    * **lifecycle** — tenants are created on first request (or
+      explicitly via :meth:`create_tenant`), dropped explicitly, or
+      evicted after ``idle_evict_s`` of inactivity.
+
+    Use as a context manager (or call ``close()``). ``close()`` fails
+    every unapplied request with an :class:`~tuplewise_tpu.serving.
+    engine.EngineClosedError` carrying the owning tenant id.
+    """
+
+    _KINDS = ("insert", "score", "query")
+
+    def __init__(self, config: Optional[ServingConfig] = None,
+                 tenancy: Optional[TenancyConfig] = None,
+                 chaos=None, tracer=None, **overrides):
+        if config is None:
+            config = ServingConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        if config.kernel != "auc":
+            raise ValueError(
+                "MultiTenantEngine serves the exact AUC fleet; "
+                f"kernel={config.kernel!r} is not supported")
+        self.config = config
+        self.tenancy = tenancy if tenancy is not None else TenancyConfig()
+        self.chaos = chaos
+        self.tracer = tracer
+        self.metrics = MetricsRegistry()
+        self.flight = FlightRecorder(
+            capacity=config.flight_recorder_size, tracer=tracer,
+            dump_path=(os.path.join(config.snapshot_dir, "flight.jsonl")
+                       if config.snapshot_dir else None))
+        if chaos is not None:
+            chaos.attach(flight=self.flight, tracer=tracer)
+        self.fleet = TenantFleetIndex(
+            window=config.window, compact_every=config.compact_every,
+            shards=config.mesh_shards, metrics=self.metrics,
+            chaos=chaos,
+            min_tenant_bucket=self.tenancy.min_tenant_bucket,
+            tracer=tracer, flight=self.flight)
+        self._streams: Dict[str, StreamingIncompleteU] = {}
+        m = self.metrics
+        self._c_req = {k: m.counter(f"requests_{k}_total")
+                       for k in self._KINDS}
+        self._c_rejected = m.counter("rejected_total")
+        self._c_dropped = m.counter("dropped_total")
+        self._c_tenant_rejected = m.counter("tenant_rejected_total")
+        self._c_tenants_created = m.counter("tenants_created_total")
+        self._c_tenants_evicted = m.counter("tenants_evicted_total")
+        self._c_batches = m.counter("batches_total")
+        self._c_events = m.counter("events_total")
+        self._c_pairs = m.counter("incomplete_pairs_total")
+        self._c_poison = m.counter("poison_rejects")
+        self._c_batcher_restarts = m.counter("batcher_restarts")
+        self._h_latency = m.histogram("request_latency_s")
+        self._h_insert_lat = m.histogram("insert_latency_s")
+        self._h_fill = m.histogram(
+            "batch_fill", buckets=[i / 16 for i in range(1, 17)])
+        self._g_depth = m.gauge("queue_depth_live")
+        self._g_live = m.gauge("tenants_live")
+        self._pending: Dict[str, Deque[_FleetRequest]] = {}
+        self._rotation: List[str] = []
+        self._n_pending = 0
+        self._inflight = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self._last_idle_check = time.monotonic()
+        self._recovery = None
+        if config.snapshot_dir:
+            self._recovery = FleetRecoveryManager(
+                config.snapshot_dir,
+                snapshot_every=config.snapshot_every,
+                wal_fsync=config.wal_fsync, tracer=tracer,
+                flight=self.flight)
+            if config.recover:
+                self._recovery.recover(self)
+            else:
+                self._recovery.start_fresh()
+        self._worker = threading.Thread(
+            target=self._supervise, name="tuplewise-fleet-batcher",
+            daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------ #
+    # tenant lifecycle                                                   #
+    # ------------------------------------------------------------------ #
+    def _ensure_tenant(self, tid: str):
+        """Create-on-first-request under the tenant cap (admission)."""
+        if self.fleet.has(tid):
+            return
+        if self.fleet.n_tenants >= self.tenancy.max_tenants:
+            self._c_tenant_rejected.inc()
+            if self.tenancy.tenant_metrics:
+                self.metrics.counter("tenant_rejected_total",
+                                     labels={"tenant": tid}).inc()
+            raise TenantRejectedError(
+                f"fleet at max_tenants={self.tenancy.max_tenants}; "
+                f"tenant {tid!r} not admitted", tenant=tid)
+        self.create_tenant(tid)
+
+    def create_tenant(self, tid: str) -> None:
+        self.fleet.create(tid)
+        if tid not in self._streams:
+            self._streams[tid] = StreamingIncompleteU(
+                kernel=self.config.kernel, budget=self.config.budget,
+                reservoir=self.config.reservoir,
+                design=self.config.design,
+                seed=tenant_seed(self.config.seed, tid))
+            self._c_tenants_created.inc()
+        self._g_live.set(self.fleet.n_tenants)
+
+    def drop_tenant(self, tid: str) -> bool:
+        """Explicit removal (lifecycle API; also the idle-eviction
+        path). Pending requests for the tenant still apply — only the
+        statistic state is dropped, so the tenant re-creates cleanly
+        on its next request."""
+        dropped = self.fleet.drop(tid)
+        self._streams.pop(tid, None)
+        if dropped:
+            self._c_tenants_evicted.inc()
+            self._g_live.set(self.fleet.n_tenants)
+        return dropped
+
+    def _maybe_evict_idle(self) -> None:
+        idle_s = self.tenancy.idle_evict_s
+        if idle_s is None:
+            return
+        now = time.monotonic()
+        if now - self._last_idle_check < min(idle_s, 1.0):
+            return
+        self._last_idle_check = now
+        for tid in self.fleet.idle_tenants(idle_s):
+            with self._cv:
+                busy = tid in self._pending
+            if not busy:
+                self.drop_tenant(tid)
+
+    # ------------------------------------------------------------------ #
+    # request side                                                       #
+    # ------------------------------------------------------------------ #
+    def submit(self, kind: str, tenant, scores=None,
+               labels=None) -> Future:
+        """Enqueue one request for ``tenant``; returns its Future.
+
+        Raises :class:`TenantRejectedError` (admission),
+        :class:`~tuplewise_tpu.serving.engine.BackpressureError`
+        (global queue policy), :class:`~tuplewise_tpu.serving.engine.
+        PoisonEventError` (edge validation) — all before the request
+        can consume shared batcher time.
+        """
+        if kind not in self._KINDS:
+            raise ValueError(f"unknown request kind {kind!r}")
+        tenant = str(tenant)
+        if self._closed:
+            raise EngineClosedError(
+                f"engine is closed (tenant={tenant})", tenant=tenant)
+        if kind == "insert":
+            scores, labels = self._validate_insert(tenant, scores, labels)
+        elif kind == "score":
+            scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
+        self._ensure_tenant(tenant)
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start(f"request.{kind}", parent=None)
+        req = _FleetRequest(kind, tenant, scores, labels, span=span)
+        if span is not None:
+            span.t0 = req.t_enqueue
+        self._c_req[kind].inc()
+        with self._cv:
+            dq = self._pending.get(tenant)
+            if dq is not None and len(dq) >= self.tenancy.tenant_quota:
+                self._c_tenant_rejected.inc()
+                if self.tenancy.tenant_metrics:
+                    self.metrics.counter(
+                        "tenant_rejected_total",
+                        labels={"tenant": tenant}).inc()
+                raise TenantRejectedError(
+                    f"tenant {tenant!r} queue quota "
+                    f"({self.tenancy.tenant_quota}) exceeded",
+                    tenant=tenant)
+            while self._n_pending >= self.config.queue_size:
+                if self.config.policy == "reject":
+                    self._c_rejected.inc()
+                    raise BackpressureError(
+                        f"fleet queue full ({self.config.queue_size}); "
+                        f"request rejected (tenant={tenant})")
+                if self.config.policy == "drop_oldest":
+                    self._drop_oldest_locked()
+                    continue
+                # block: wait for capacity; a close() must unblock us
+                self._cv.wait(timeout=0.05)
+                if self._closed:
+                    raise EngineClosedError(
+                        "engine closed while blocked on queue capacity "
+                        f"(tenant={tenant})", tenant=tenant)
+            if dq is None:
+                dq = self._pending[tenant] = collections.deque()
+                self._rotation.append(tenant)
+            dq.append(req)
+            self._n_pending += 1
+            self._cv.notify_all()
+        return req.future
+
+    def _drop_oldest_locked(self) -> None:
+        """drop_oldest across tenants: shed the head of the LONGEST
+        per-tenant queue — freshness for everyone, and the flooding
+        tenant pays first."""
+        if not self._pending:
+            return
+        tid = max(self._pending, key=lambda t: len(self._pending[t]))
+        old = self._pending[tid].popleft()
+        if not self._pending[tid]:
+            del self._pending[tid]
+            self._rotation.remove(tid)
+        self._n_pending -= 1
+        self._c_dropped.inc()
+        if not old.future.done():
+            old.future.set_exception(BackpressureError(
+                f"dropped by a newer request (drop_oldest, "
+                f"tenant={old.tenant})"))
+
+    def _validate_insert(self, tenant, scores, labels):
+        scores = np.atleast_1d(np.asarray(scores, dtype=np.float64))
+        labels = np.atleast_1d(np.asarray(labels))
+        msg = None
+        if scores.shape != labels.shape:
+            msg = (f"insert: scores/labels shape mismatch: "
+                   f"{scores.shape} vs {labels.shape}")
+        elif len(scores) and not np.all(np.isfinite(scores)):
+            msg = "insert: non-finite score(s) rejected"
+        elif labels.dtype.kind == "f" and len(labels) \
+                and not np.all(np.isfinite(labels)):
+            msg = "insert: non-finite label(s) rejected"
+        if msg is not None:
+            self._c_poison.inc()
+            self.flight.record("poison_reject", reason=msg,
+                               tenant=tenant)
+            raise PoisonEventError(f"{msg} (tenant={tenant})")
+        return scores, labels
+
+    def insert(self, tenant, scores, labels) -> Future:
+        return self.submit("insert", tenant, scores, labels)
+
+    def score(self, tenant, scores) -> Future:
+        return self.submit("score", tenant, scores)
+
+    def query(self, tenant) -> Future:
+        return self.submit("query", tenant)
+
+    def flush(self, timeout: Optional[float] = 30.0) -> None:
+        """Barrier: everything enqueued so far is applied on return."""
+        deadline = time.monotonic() + (timeout or 30.0)
+        with self._cv:
+            while (self._n_pending or self._inflight) \
+                    and not self._closed:
+                self._cv.wait(timeout=0.05)
+                if time.monotonic() >= deadline:
+                    raise TimeoutError("fleet flush timed out")
+
+    # ------------------------------------------------------------------ #
+    # batcher side                                                       #
+    # ------------------------------------------------------------------ #
+    def _supervise(self) -> None:
+        while True:
+            try:
+                self._run()
+                return
+            except BaseException as e:
+                if self._closed:
+                    return
+                self._c_batcher_restarts.inc()
+                self.flight.record("batcher_restart", error=repr(e))
+                self.flight.auto_dump()
+
+    def _run(self) -> None:
+        while True:
+            if self.chaos is not None:
+                self.chaos.fire("batcher")
+            batch = self._next_batch()
+            if batch is None:
+                self._fail_pending()
+                return
+            if batch:
+                try:
+                    self._dispatch(batch)
+                finally:
+                    with self._cv:
+                        self._inflight = 0
+                        self._cv.notify_all()
+            self._maybe_evict_idle()
+
+    def _next_batch(self) -> Optional[List[_FleetRequest]]:
+        with self._cv:
+            while self._n_pending == 0:
+                if self._closed:
+                    return None
+                self._cv.wait(timeout=0.05)
+            if self._closed:
+                # close() fails unapplied requests (tenant-attributed)
+                # instead of serving them late
+                return None
+            deadline = time.perf_counter() + self.config.flush_timeout_s
+            while (self._n_pending < self.config.max_batch
+                   and not self._closed):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            self._g_depth.set(self._n_pending)
+            batch = self._drr_take(self.config.max_batch)
+            self._inflight = len(batch)
+            self._cv.notify_all()    # capacity freed: wake producers
+            return batch
+
+    def _drr_take(self, n: int) -> List[_FleetRequest]:
+        """Deficit-round-robin drain (lock held): every pending tenant
+        is served up to ``weight`` requests per round before any
+        tenant is served twice — the starvation-free order."""
+        out: List[_FleetRequest] = []
+        w = self.tenancy.weight
+        while len(out) < n and self._rotation:
+            tid = self._rotation.pop(0)
+            dq = self._pending.get(tid)
+            if dq is None:
+                continue
+            take = min(w, n - len(out), len(dq))
+            for _ in range(take):
+                out.append(dq.popleft())
+            self._n_pending -= take
+            if dq:
+                self._rotation.append(tid)
+            else:
+                del self._pending[tid]
+        return out
+
+    @staticmethod
+    def _waves(batch: List[_FleetRequest]):
+        """Split a drained batch into kind waves that preserve each
+        tenant's submission order: per tenant, consecutive same-kind
+        segments; wave i = every tenant's i-th segment, grouped by
+        kind. Inserts across tenants in one wave coalesce into one
+        fleet count."""
+        segs: Dict[str, List[Tuple[str, List[_FleetRequest]]]] = {}
+        for r in batch:
+            runs = segs.setdefault(r.tenant, [])
+            if runs and runs[-1][0] == r.kind:
+                runs[-1][1].append(r)
+            else:
+                runs.append((r.kind, [r]))
+        depth = max((len(v) for v in segs.values()), default=0)
+        for i in range(depth):
+            wave: Dict[str, List[Tuple[str, List[_FleetRequest]]]] = {
+                "insert": [], "score": [], "query": []}
+            for tid, runs in segs.items():
+                if i < len(runs):
+                    kind, reqs = runs[i]
+                    wave[kind].append((tid, reqs))
+            yield wave
+
+    def _dispatch(self, batch: List[_FleetRequest]) -> None:
+        self._c_batches.inc()
+        self._h_fill.observe(len(batch) / self.config.max_batch)
+        for wave in self._waves(batch):
+            if wave["insert"]:
+                self._apply_insert_wave(wave["insert"])
+            if wave["score"]:
+                self._apply_score_wave(wave["score"])
+            for tid, reqs in wave["query"]:
+                snap = self.tenant_stats(tid)
+                for r in reqs:
+                    r.future.set_result(snap)
+                    self._finish(r)
+
+    def _finish(self, r: _FleetRequest,
+                now: Optional[float] = None) -> None:
+        now = now if now is not None else time.perf_counter()
+        self._h_latency.observe(now - r.t_enqueue)
+        if self.tracer is not None and r.span is not None:
+            self.tracer.finish(r.span, now)
+            r.span = None
+
+    def _apply_insert_wave(self, groups) -> None:
+        """One wave of per-tenant insert runs → ONE fleet count +
+        per-tenant stream extends; futures resolve per request."""
+        items = []
+        for tid, reqs in groups:
+            scores = np.concatenate([r.scores for r in reqs])
+            labels = np.concatenate(
+                [r.labels for r in reqs]).astype(bool)
+            items.append((tid, scores, labels))
+        with maybe_span(self.tracer, "fleet.insert_wave",
+                        n_tenants=len(items)):
+            try:
+                if self._recovery is not None:
+                    for tid, scores, labels in items:
+                        self._recovery.record(scores, labels, tenant=tid)
+                self.fleet.apply_inserts(items)
+                for tid, scores, labels in items:
+                    spent = self._streams[tid].extend(scores, labels)
+                    self._c_pairs.inc(spent)
+                    self._c_events.inc(len(scores))
+                if self._recovery is not None:
+                    self._recovery.maybe_snapshot(self)
+            except Exception as e:
+                for _, reqs in groups:
+                    for r in reqs:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                        self._finish(r)
+                return
+        now = time.perf_counter()
+        for tid, reqs in groups:
+            h_tenant = None
+            if self.tenancy.tenant_metrics:
+                h_tenant = self.metrics.histogram(
+                    "insert_latency_s", labels={"tenant": tid})
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_result(len(r.scores))
+                lat = now - r.t_enqueue
+                self._h_insert_lat.observe(lat)
+                if h_tenant is not None:
+                    h_tenant.observe(lat)
+                self._finish(r, now)
+
+    def _apply_score_wave(self, groups) -> None:
+        items = []
+        for tid, reqs in groups:
+            items.append((tid,
+                          np.concatenate([r.scores for r in reqs])))
+        try:
+            ranks = self.fleet.apply_scores(items)
+        except Exception as e:
+            for _, reqs in groups:
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                    self._finish(r)
+            return
+        for (tid, reqs), rk in zip(groups, ranks):
+            off = 0
+            for r in reqs:
+                n = len(r.scores)
+                if not r.future.done():
+                    r.future.set_result(rk[off:off + n])
+                off += n
+                self._finish(r)
+
+    def _fail_pending(self) -> None:
+        """Fail every queued request with a tenant-attributed
+        EngineClosedError (the fleet twin of the ISSUE 8 bugfix)."""
+        with self._cv:
+            pending = list(self._pending.items())
+            self._pending.clear()
+            self._rotation.clear()
+            self._n_pending = 0
+            self._cv.notify_all()
+        for tid, dq in pending:
+            for r in dq:
+                if not r.future.done():
+                    r.future.set_exception(EngineClosedError(
+                        "engine closed before the request was applied "
+                        f"(tenant={tid})", tenant=tid))
+                self._finish(r)
+
+    # ------------------------------------------------------------------ #
+    def tenant_stats(self, tid: str) -> dict:
+        out = dict(self.fleet.tenant_state(tid) or {"tenant": tid})
+        st = self._streams.get(tid)
+        if st is not None:
+            out["estimate_incomplete"] = st.estimate()
+            out["streaming"] = st.state()
+        out["auc_exact"] = out.pop("auc", None)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "metrics": self.metrics.snapshot(),
+            "fleet": self.fleet.state(),
+            "tenants_live": self.fleet.n_tenants,
+        }
+
+    def close(self, timeout: float = 10.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._cv:
+            self._cv.notify_all()
+        self._worker.join(timeout=timeout)
+        self._fail_pending()
+        if self._recovery is not None:
+            self._recovery.checkpoint_and_close(self)
+        self.flight.record("engine_closed")
+        self.flight.auto_dump()
+
+    def __enter__(self) -> "MultiTenantEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# --------------------------------------------------------------------- #
+# fleet crash safety                                                     #
+# --------------------------------------------------------------------- #
+
+def _fleet_compat_config(config: ServingConfig,
+                         tenancy: TenancyConfig) -> dict:
+    return {
+        "kernel": config.kernel, "budget": config.budget,
+        "reservoir": config.reservoir, "design": config.design,
+        "window": config.window, "seed": config.seed,
+        "max_tenants": tenancy.max_tenants,
+    }
+
+
+def capture_fleet_snapshot_state(engine) -> Tuple[dict, dict]:
+    """Consistent cut of EVERY tenant's state (batcher thread, fleet
+    lock): containers + log as arrays keyed by a dense tenant index,
+    wins2 (decimal strings) + RNG states + the tenant-id manifest in
+    the JSON config block."""
+    from tuplewise_tpu.utils.rng import capture_np_rng
+
+    fleet = engine.fleet
+    extra: dict = {}
+    cfg = dict(_fleet_compat_config(engine.config, engine.tenancy))
+    tids, wins2, rngs, counters = [], [], [], []
+    with fleet._lock:
+        for st in fleet._slots:
+            if st is None:
+                continue
+            i = len(tids)
+            tids.append(st.tid)
+            wins2.append(str(st.wins2))
+            counters.append([st.n_evicted, st.n_compactions])
+            for name, pos in (("pos", True), ("neg", False)):
+                base, buf, tomb = st.side(pos)
+                extra[f"t{i}_{name}_base"] = np.asarray(base,
+                                                        dtype=fleet.dtype)
+                extra[f"t{i}_{name}_buf"] = np.asarray(buf,
+                                                       dtype=fleet.dtype)
+                extra[f"t{i}_{name}_tomb"] = np.asarray(tomb,
+                                                        dtype=fleet.dtype)
+            extra[f"t{i}_log_scores"] = np.asarray(
+                [v for v, _ in st.log], dtype=fleet.dtype)
+            extra[f"t{i}_log_labels"] = np.asarray(
+                [p for _, p in st.log], dtype=bool)
+            stream = engine._streams[st.tid]
+            extra[f"t{i}_stream_sums"] = np.asarray(
+                [stream._sum_h, stream._sum_h2], dtype=np.float64)
+            extra[f"t{i}_stream_counts"] = np.asarray(
+                [stream._n_terms, stream.n_arrivals], dtype=np.int64)
+            for rname, res in (("rpos", stream._pos),
+                               ("rneg", stream._neg)):
+                extra[f"t{i}_{rname}_items"] = res.items[: res.size].copy()
+                extra[f"t{i}_{rname}_meta"] = np.asarray(
+                    [res.size, res.seen], dtype=np.int64)
+            rngs.append(capture_np_rng(stream._rng))
+    cfg["tenants"] = tids
+    cfg["wins2"] = wins2
+    cfg["tenant_counters"] = counters
+    cfg["rng_states"] = rngs
+    return extra, cfg
+
+
+def restore_fleet_snapshot(directory: str, engine) -> Optional[int]:
+    """Restore a fleet snapshot into a fresh engine; returns the
+    snapshot's event seq (None when no snapshot exists)."""
+    from tuplewise_tpu.utils.checkpoint import load_checkpoint
+    from tuplewise_tpu.utils.rng import restore_np_rng
+
+    ck = load_checkpoint(os.path.join(directory, "snapshot.npz"))
+    if ck is None:
+        return None
+    cfg, extra = ck["config"], ck["extra"]
+    want = _fleet_compat_config(engine.config, engine.tenancy)
+    check_config({k: cfg.get(k) for k in want}, want)
+    fleet = engine.fleet
+    with fleet._lock:
+        for i, tid in enumerate(cfg["tenants"]):
+            engine.create_tenant(tid)
+            st = fleet._by_tid[tid]
+            for name, pos in (("pos", True), ("neg", False)):
+                base = extra[f"t{i}_{name}_base"].astype(fleet.dtype)
+                buf = extra[f"t{i}_{name}_buf"].astype(
+                    fleet.dtype).tolist()
+                tomb = extra[f"t{i}_{name}_tomb"].astype(
+                    fleet.dtype).tolist()
+                if pos:
+                    st.pos_base, st.pos_buf, st.pos_tomb = base, buf, tomb
+                else:
+                    st.neg_base, st.neg_buf, st.neg_tomb = base, buf, tomb
+            st.log = collections.deque(zip(
+                extra[f"t{i}_log_scores"].astype(fleet.dtype).tolist(),
+                [bool(b) for b in extra[f"t{i}_log_labels"]]))
+            st.wins2 = int(cfg["wins2"][i])
+            st.n_evicted, st.n_compactions = (
+                int(x) for x in cfg["tenant_counters"][i])
+            stream = engine._streams[tid]
+            stream._sum_h, stream._sum_h2 = (
+                float(x) for x in extra[f"t{i}_stream_sums"])
+            stream._n_terms, stream.n_arrivals = (
+                int(x) for x in extra[f"t{i}_stream_counts"])
+            for rname, res in (("rpos", stream._pos),
+                               ("rneg", stream._neg)):
+                size, seen = (int(x) for x in extra[f"t{i}_{rname}_meta"])
+                res.items[:size] = extra[f"t{i}_{rname}_items"]
+                res.size, res.seen = size, seen
+            restore_np_rng(stream._rng, cfg["rng_states"][i])
+        fleet._pos_pack.dirty = True
+        fleet._neg_pack.dirty = True
+    return int(ck["step"])
+
+
+class FleetRecoveryManager(RecoveryManager):
+    """The fleet's recovery manager: same WAL/segment/async-writer
+    protocol, fleet-shaped capture/restore, tenant-tagged replay."""
+
+    def _capture(self, engine):
+        return capture_fleet_snapshot_state(engine)
+
+    def _restore(self, engine):
+        return restore_fleet_snapshot(self.directory, engine)
+
+    def _replay_entry(self, engine, rec: dict) -> None:
+        tid = str(rec.get("t", "default"))
+        scores = np.asarray(rec["s"], dtype=np.float64)
+        labels = np.asarray(rec["l"], dtype=bool)
+        engine.create_tenant(tid)
+        engine.fleet.apply_inserts([(tid, scores, labels)])
+        engine._streams[tid].extend(scores, labels)
