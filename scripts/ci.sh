@@ -39,12 +39,16 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
-# Multi-tenant fleet smoke [ISSUE 8]: T=32 tenants over 2 mesh shards
-# through the MultiTenantEngine — per-tenant wins2/AUC bit-identical
-# to 32 independent single-tenant indexes, ONE jitted batched count
-# per coalesced micro-batch, a healthy per-tenant (label-wildcard)
-# SLO verdict with one series per tenant, and typed quota shedding;
-# writes results/multitenant_smoke.jsonl for the CI artifact.
+# Multi-tenant fleet smoke [ISSUE 8, whale leg ISSUE 9]: T=32 tenants
+# over 2 mesh shards through the MultiTenantEngine — per-tenant
+# wins2/AUC bit-identical to 32 independent single-tenant indexes,
+# ONE jitted batched count per coalesced micro-batch, a healthy
+# per-tenant (label-wildcard) SLO verdict with one series per tenant,
+# typed quota shedding, PLUS the whale leg: one tenant at ~20x the
+# median promotes (fleet_whale_promotions fired), parity holds through
+# the promotion, and dirty-row placement ships strictly less than the
+# full pack per re-place; writes results/multitenant_smoke.jsonl for
+# the CI artifact.
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python scripts/multitenant_smoke.py
@@ -109,11 +113,12 @@ PYEOF
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
-# Perf gate [ISSUE 7, flipped to fail in ISSUE 8]: the newest
-# bench_streaming row in the committed results/serving.jsonl vs its
-# history, with noise bands. The warn soak is over — serving.jsonl now
-# carries joinable (run_id + config_digest) history, so a breach is a
-# real regression and fails CI.
+# Perf gate [ISSUE 7, fail since ISSUE 8, multi-stage since ISSUE 9]:
+# the newest row of EACH gated stage (bench_streaming, multi_tenant,
+# fleet_incremental — the last adds bytes-per-pack-re-place so the
+# dirty-row saving can never quietly regress) in the committed
+# results/serving.jsonl vs its comparable history, with noise bands;
+# any stage breach fails CI.
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
     python scripts/perf_gate.py --mode fail
 exit $?

@@ -17,6 +17,12 @@ Asserts the properties the fleet exists for:
    survives into the record.
 4. **Admission control** — a quota-busting flood is shed typed
    (``TenantRejectedError``) without touching other tenants' results.
+5. **Whale promotion + dirty-row placement** [ISSUE 9] — one tenant at
+   ~20x the median crosses ``whale_threshold`` and promotes to its own
+   delta-tiered index (``fleet_whale_promotions`` fired), per-tenant
+   parity holds through the promotion, and every geometry-stable pack
+   re-place ships strictly less than the full ``[S, T_bucket, cap]``
+   block (``bytes_h2d_saved`` > 0, partial re-places > 0).
 
 Writes ``results/multitenant_smoke.jsonl`` for the CI artifact.
 Run via scripts/ci.sh (needs the 8-virtual-device XLA flags).
@@ -145,6 +151,61 @@ def admission_leg():
     return {"rejected_tenant": rejected}
 
 
+def whale_leg():
+    """[ISSUE 9] One tenant at ~20x the median: promotion fires, the
+    fleet stays bit-identical to independents through it, and the
+    dirty-row path strictly beats the full-pack ship per re-place."""
+    rng = np.random.default_rng(21)
+    T_SMALL, PER_ROUND, ROUNDS = 15, 4, 20
+    whale_per_round = PER_ROUND * 20          # 20x the median tenant
+    fleet = TenantFleetIndex(compact_every=64, shards=SHARDS,
+                             whale_threshold=400)
+    singles = {}
+
+    def batch(tid, k):
+        labels = rng.random(k) < 0.5
+        scores = rng.standard_normal(k) + 0.8 * labels
+        if tid not in singles:
+            singles[tid] = ExactAucIndex(compact_every=64,
+                                         engine="jax")
+        singles[tid].insert_batch(scores, labels)
+        return (tid, scores, labels)
+
+    for _ in range(ROUNDS):
+        items = [batch("whale", whale_per_round)]
+        items += [batch(f"s{k}", PER_ROUND) for k in range(T_SMALL)]
+        fleet.apply_inserts(items)
+    m = fleet.metrics.snapshot()
+    promotions = m["fleet_whale_promotions"]["value"]
+    assert promotions >= 1, "whale never promoted"
+    assert fleet.is_whale("whale")
+    mismatches = [t for t in singles
+                  if fleet.wins2(t) != singles[t]._wins2
+                  or fleet.auc(t) != singles[t].auc()]
+    assert not mismatches, f"parity broke through promotion: " \
+                           f"{mismatches}"
+    # strict per-re-place byte saving: geometry-stable re-places ship
+    # only dirty rows, so partial re-places dominate and every one of
+    # them credits saved bytes
+    replaces = m["pack_replaces_total"]["value"]
+    full = m["pack_full_replaces_total"]["value"]
+    saved = m["bytes_h2d_saved"]["value"]
+    assert replaces - full > 0, (replaces, full)
+    assert saved > 0, "dirty-row placement saved nothing"
+    shipped = m["bytes_h2d"]["value"]
+    assert shipped < shipped + saved, "vacuous"
+    frac = shipped / (shipped + saved)
+    assert frac < 0.5, f"re-places shipped {frac:.0%} of full cost"
+    fleet.close()
+    return {"promotions": int(promotions),
+            "tenants": len(singles),
+            "pack_replaces": int(replaces),
+            "pack_partial_replaces": int(replaces - full),
+            "bytes_h2d": int(shipped), "bytes_h2d_saved": int(saved),
+            "shipped_fraction_of_full": round(frac, 4),
+            "parity": "bit-identical"}
+
+
 def main() -> int:
     rec = {"stage": "multitenant_smoke", "tenants": T,
            "mesh_shards": SHARDS, "n_events": N_EVENTS}
@@ -156,6 +217,9 @@ def main() -> int:
           file=sys.stderr)
     rec["admission"] = admission_leg()
     print(f"[multitenant_smoke] admission OK ({rec['admission']})",
+          file=sys.stderr)
+    rec["whale"] = whale_leg()
+    print(f"[multitenant_smoke] whale leg OK ({rec['whale']})",
           file=sys.stderr)
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a", encoding="utf-8") as f:

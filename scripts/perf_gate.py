@@ -39,11 +39,21 @@ is now 0.25 — wide enough for host variance, still far below the
 ``--tolerance-frac 0.15`` restores the tight band for same-host
 comparisons.
 
-Always writes the verdict row (stage ``perf_gate``) to ``--out`` for
-the CI artifact, and prints it as one stdout JSON line.
+Since ISSUE 9 the gate bands MULTIPLE stages per run: the default
+``--stage`` list covers ``bench_streaming``, ``multi_tenant`` (T=256
+cell throughput + insert p99), and ``fleet_incremental`` (throughput,
+insert p99, and host→device bytes per pack re-place — the dirty-row
+regression the incremental fleet path must never quietly lose). Each
+stage gates against its own comparable history with its own metric
+spec; the combined verdict fails when ANY stage breaches.
+
+Always writes the verdict row (stage ``perf_gate``, per-stage
+verdicts under ``stages``) to ``--out`` for the CI artifact, and
+prints it as one stdout JSON line.
 
 Usage: python scripts/perf_gate.py [--history results/serving.jsonl]
                                    [--mode warn|fail]
+                                   [--stage bench_streaming,...]
                                    [--out results/perf_gate.jsonl]
 """
 
@@ -60,6 +70,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # metric -> direction ("min" = lower is better)
 _GATED = (("events_per_s", "max", "value"),
           ("insert_latency_p99_ms", "min", "insert_latency_p99_ms"))
+
+# per-stage metric specs [ISSUE 9 satellite]: the gate now bands the
+# multi_tenant and fleet_incremental trajectories too (before, only
+# bench_streaming rows were read back — a fleet regression would merge
+# as one more row). Value fields are dotted paths into the row.
+_STAGE_METRICS = {
+    "bench_streaming": _GATED,
+    "multi_tenant": (
+        ("events_per_s_T256", "max", "cells.256.events_per_s"),
+        ("insert_p99_ms_T256", "min", "cells.256.insert_p99_ms"),
+    ),
+    "fleet_incremental": (
+        ("events_per_s", "max", "events_per_s"),
+        ("insert_latency_p99_ms", "min", "insert_latency_p99_ms"),
+        ("bytes_per_replace", "min", "bytes_per_replace"),
+    ),
+}
+_DEFAULT_STAGES = "bench_streaming,multi_tenant,fleet_incremental"
 
 # the config fields that make two bench_streaming rows comparable when
 # no config_digest is stamped (pre-ISSUE-7 history)
@@ -100,15 +128,25 @@ def comparable_history(rows, newest):
     return out
 
 
+def _get_path(row: dict, path: str):
+    """Resolve a dotted path ("cells.256.events_per_s") into a row."""
+    cur = row
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
 def _value(row: dict, metric: str, value_field: str):
-    # events_per_s lives under "value" in bench rows (metric field
-    # says events/sec); p99 is a first-class field
-    if metric == "events_per_s":
+    # bench_streaming's events_per_s lives under "value" (metric field
+    # says events/sec); everything else resolves by (dotted) path
+    if value_field == "value":
         v = row.get("value")
         if v is None:
             v = row.get("events_per_s")
         return v
-    return row.get(value_field)
+    return _get_path(row, value_field)
 
 
 def _mad(xs, center):
@@ -116,7 +154,7 @@ def _mad(xs, center):
 
 
 def gate(rows, tolerance_frac: float, mad_k: float,
-         min_history: int) -> dict:
+         min_history: int, metrics=_GATED) -> dict:
     newest = rows[-1]
     hist = comparable_history(rows, newest)
     verdict = {
@@ -135,7 +173,7 @@ def gate(rows, tolerance_frac: float, mad_k: float,
             f"insufficient comparable history ({len(hist)} < "
             f"{min_history}) — gate passes vacuously")
         return verdict
-    for metric, direction, field in _GATED:
+    for metric, direction, field in metrics:
         new = _value(newest, metric, field)
         xs = [v for v in (_value(r, metric, field) for r in hist)
               if v is not None]
@@ -168,7 +206,11 @@ def main(argv=None) -> int:
     ap.add_argument("--history", type=str,
                     default=os.path.join(REPO, "results",
                                          "serving.jsonl"))
-    ap.add_argument("--stage", type=str, default="bench_streaming")
+    ap.add_argument("--stage", "--stages", dest="stages", type=str,
+                    default=_DEFAULT_STAGES,
+                    help="comma-separated stages to gate (each with "
+                         "its own metric spec; unknown stages use the "
+                         "bench_streaming spec)")
     ap.add_argument("--mode", choices=["warn", "fail"], default="warn")
     ap.add_argument("--min-history", type=int, default=2)
     ap.add_argument("--tolerance-frac", type=float, default=0.25,
@@ -188,32 +230,49 @@ def main(argv=None) -> int:
         print(f"PERF GATE: no history file {args.history!r} — "
               "nothing to gate", file=sys.stderr)
         return 0
-    rows = load_rows(args.history, args.stage)
-    if not rows:
-        print(f"PERF GATE: no {args.stage!r} rows in {args.history!r}",
+    stages = [s.strip() for s in args.stages.split(",") if s.strip()]
+    stage_verdicts = {}
+    for stage in stages:
+        rows = load_rows(args.history, stage)
+        if not rows:
+            print(f"PERF GATE: no {stage!r} rows in {args.history!r}",
+                  file=sys.stderr)
+            continue
+        v = gate(rows, args.tolerance_frac, args.mad_k,
+                 args.min_history,
+                 metrics=_STAGE_METRICS.get(stage, _GATED))
+        v["gated_stage"] = stage
+        stage_verdicts[stage] = v
+    if not stage_verdicts:
+        print(f"PERF GATE: no gateable rows in {args.history!r}",
               file=sys.stderr)
         return 0
 
-    verdict = gate(rows, args.tolerance_frac, args.mad_k,
-                   args.min_history)
-    verdict["mode"] = args.mode
+    verdict = {
+        "stage": "perf_gate",
+        "mode": args.mode,
+        "ok": all(v["ok"] for v in stage_verdicts.values()),
+        "stages": stage_verdicts,
+    }
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(json.dumps(verdict) + "\n")
     print(json.dumps(verdict))
     if not verdict["ok"]:
-        bad = [c["metric"] for c in verdict["checks"] if not c["ok"]]
+        bad = [f"{s}:{c['metric']}"
+               for s, v in stage_verdicts.items()
+               for c in v["checks"] if not c["ok"]]
         msg = (f"PERF GATE {'FAIL' if args.mode == 'fail' else 'WARN'}:"
-               f" regression in {bad} vs {verdict['n_history']}-row "
-               f"history (bands in {args.out})")
+               f" regression in {bad} (bands in {args.out})")
         print(msg, file=sys.stderr)
         if args.mode == "fail":
             return 1
     else:
-        print(f"PERF GATE OK: {len(verdict['checks'])} checks vs "
-              f"{verdict['n_history']} comparable rows",
-              file=sys.stderr)
+        n_checks = sum(len(v["checks"])
+                       for v in stage_verdicts.values())
+        print(f"PERF GATE OK: {n_checks} checks across "
+              f"{len(stage_verdicts)} stages", file=sys.stderr)
     return 0
 
 

@@ -510,6 +510,22 @@ def main(argv=None) -> int:
         p.add_argument("--idle-evict-s", type=float, default=None,
                        help="fleet: drop tenants idle longer than this "
                             "(default: never)")
+        # incremental fleet hot path [ISSUE 9]
+        p.add_argument("--whale-threshold", type=int, default=None,
+                       help="fleet: promote a tenant to its own "
+                            "delta-tiered ExactAucIndex once its live "
+                            "event count reaches this (O(buffer) "
+                            "compactions instead of the O(tenant) pack "
+                            "splice; demotes on shrink; bit-identical "
+                            "either way). Default: never promote")
+        p.add_argument("--tenant-metric-cap", type=int, default=None,
+                       help="fleet: at most this many tenants get "
+                            "their own labeled metric series; later "
+                            "tenants collapse into one "
+                            "{tenant=__other__} series (bounds the "
+                            "registry, MetricsFlusher rows, and SLO "
+                            "wildcard fan-out at 100k-tenant scale). "
+                            "Default: unbounded")
         p.add_argument("--seed", type=int, default=0)
 
     p = sub.add_parser(
@@ -609,7 +625,9 @@ def main(argv=None) -> int:
                 max_tenants=args.max_tenants or 1024,
                 tenant_quota=args.tenant_quota,
                 weight=args.tenant_weight,
-                idle_evict_s=args.idle_evict_s)
+                idle_evict_s=args.idle_evict_s,
+                whale_threshold=args.whale_threshold,
+                tenant_metric_cap=args.tenant_metric_cap)
         if args.cmd == "replay":
             if args.tenants > 1:
                 # fleet load generation [ISSUE 8 satellite]: Zipf

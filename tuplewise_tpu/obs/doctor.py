@@ -169,7 +169,15 @@ def tenant_breakdown(metrics_rows: List[dict]) -> Optional[dict]:
             breached = out[tid].setdefault("slo_breached", [])
             if snap.get("value"):
                 breached.append(labels.get("objective"))
-    return dict(out) or None
+    if not out:
+        return None
+    # bounded cardinality [ISSUE 9 satellite]: when tenant_metric_cap
+    # collapsed tenants into the {tenant=__other__} series, surface how
+    # many distinct tenants that one series hides
+    collapsed = m.get("tenant_metric_collapsed", {}).get("value", 0)
+    if collapsed and "__other__" in out:
+        out["__other__"]["collapsed_tenants"] = int(collapsed)
+    return dict(out)
 
 
 def _span_for_trace(spans: List[dict], trace_id) -> Optional[str]:

@@ -133,6 +133,18 @@ def service_report(metrics: dict, chaos=None,
                                         "tenant_rejected_total"),
             "fleet_count_calls": _v(metrics, "fleet_count_calls_total"),
             "fleet_compact_aborts": _v(metrics, "fleet_compact_aborts"),
+            # incremental hot path [ISSUE 9]: whale lifecycle, pack
+            # placement accounting, and the metric-cardinality cap
+            "whale_promotions": _v(metrics, "fleet_whale_promotions"),
+            "whale_demotions": _v(metrics, "fleet_whale_demotions"),
+            "whales_live": _v(metrics, "fleet_whales"),
+            "pack_replaces": _v(metrics, "pack_replaces_total"),
+            "pack_full_replaces": _v(metrics,
+                                     "pack_full_replaces_total"),
+            "pack_occupancy": _v(metrics, "pack_occupancy"),
+            "pack_stale_rows": _v(metrics, "pack_stale_rows"),
+            "tenant_metric_collapsed": _v(metrics,
+                                          "tenant_metric_collapsed"),
         }
     if chaos is not None:
         report["chaos"] = chaos.snapshot()

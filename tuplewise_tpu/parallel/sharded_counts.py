@@ -66,6 +66,23 @@ packs and both query blocks under ONE psum, so one jitted call serves
 a whole coalesced batch of tenants' queries. Compile shapes follow
 the ``(T_bucket, cap, q_bucket)`` ladder — powers of two in each axis
 — never the live tenant count or the batch's tenant mix.
+
+**Dirty-row pack placement** [ISSUE 9]: the ``place_base`` prev-trick
+generalized to the tenant axis. A fleet re-place used to ship the
+whole ``[S, T_bucket, cap]`` block even when ONE tenant of 256
+compacted. ``place_tenant_pack(prev=..., dirty=...)`` keeps the
+resident per-device shards and ships only the dirty tenants' rows: a
+small ``[db, cap]`` block per device is scattered into the shard at
+the dirty slots (a jitted ``.at[0, idx].set(..., mode="drop")`` —
+out-of-range padding indices drop, so the dirty count pads to a tiny
+power-of-two bucket without a compile shape per count), and the
+global array reassembles from the surviving single-device shards.
+Host→device bytes per re-place become O(dirty · cap · S) instead of
+O(T_bucket · cap · S) — the incomplete-U budget framing applied to
+transfer: per-tenant maintenance cost scales with per-tenant change,
+not fleet size. Reuse requires stable geometry (same T_bucket, the
+required cap no larger than the placed cap, same mesh); a T_bucket or
+cap outgrowth forces the full ship, exactly like the base-run ladder.
 """
 
 from __future__ import annotations
@@ -621,7 +638,7 @@ def tenant_bucket(n: int, min_bucket: int = _MIN_TENANT_BUCKET) -> int:
 
 
 def place_tenant_pack(mesh, runs: Sequence[np.ndarray], t_bucket: int,
-                      dtype, *, metrics=None,
+                      dtype, *, prev=None, dirty=None, metrics=None,
                       chaos=None) -> Tuple[object, int, int]:
     """Pack a fleet of sorted runs into one shared padded device buffer.
 
@@ -636,6 +653,14 @@ def place_tenant_pack(mesh, runs: Sequence[np.ndarray], t_bucket: int,
     whole fleet, padding proportional to the biggest tenant). All
     padding is +inf, so finite queries count exactly without masks.
 
+    ``prev`` — ``(prev_dev, prev_cap, prev_t_bucket)`` of the placement
+    this one replaces; ``dirty`` — the slot indices whose runs changed
+    since it (None = unknown/all). When the geometry is stable (same
+    ``t_bucket``, required cap <= ``prev_cap``, same mesh width) only
+    the dirty slots' rows are shipped and scattered into the resident
+    per-device shards [ISSUE 9 tentpole]; the bytes a naive full
+    re-ship would have cost land in ``bytes_h2d_saved``.
+
     Returns ``(device_array, cap, shipped_bytes)``; bytes are credited
     to ``bytes_h2d`` like every other placement. ``chaos`` fires the
     ``place_base`` hook (a raise here exercises the fleet's
@@ -648,8 +673,32 @@ def place_tenant_pack(mesh, runs: Sequence[np.ndarray], t_bucket: int,
         chaos.fire("place_base")
     S = mesh_size(mesh) if mesh is not None else 1
     pers = [-(-len(r) // S) if len(r) else 0 for r in runs]
-    cap = next_bucket(max(pers, default=1) or 1)
+    need_cap = next_bucket(max(pers, default=1) or 1)
     itemsize = np.dtype(dtype).itemsize
+
+    if prev is not None and dirty is not None:
+        prev_dev, prev_cap, prev_tb = prev
+        # geometry-stable reuse: keep the (possibly larger) placed cap
+        # — extra +inf padding never changes a finite query's counts —
+        # and ship only the dirty rows. Any mismatch falls through to
+        # the full ship below.
+        if (prev_dev is not None and prev_tb == t_bucket
+                and need_cap <= prev_cap
+                and all(0 <= t < t_bucket for t in dirty)):
+            full_bytes = S * t_bucket * prev_cap * itemsize
+            if not dirty:
+                _count_bytes(metrics, 0, full_bytes)
+                return prev_dev, prev_cap, 0
+            try:
+                dev, shipped = _update_pack_rows(
+                    mesh, prev_dev, runs, sorted(dirty), S, t_bucket,
+                    prev_cap, dtype)
+                _count_bytes(metrics, shipped, full_bytes - shipped)
+                return dev, prev_cap, shipped
+            except Exception:
+                pass    # any API/topology mismatch: full re-ship
+
+    cap = need_cap
     block = np.full((S, t_bucket, cap), np.inf, dtype=dtype)
     for t, r in enumerate(runs):
         per = pers[t]
@@ -666,6 +715,80 @@ def place_tenant_pack(mesh, runs: Sequence[np.ndarray], t_bucket: int,
         dev = jax.device_put(jnp.asarray(block), row_sharding(mesh))
     _count_bytes(metrics, shipped, 0)
     return dev, cap, shipped
+
+
+@functools.lru_cache(maxsize=None)
+def _pack_scatter_fn(t_bucket: int, cap: int, db: int, sharded: bool):
+    """Jitted dirty-row scatter [ISSUE 9]: write ``db`` replacement
+    rows into a resident pack shard at the given slot indices. The
+    dirty count pads to the power-of-two bucket ``db``; padding
+    entries carry slot index ``t_bucket`` (out of range) and drop —
+    one compiled shape per (t_bucket, cap, db) ladder point, never per
+    dirty set."""
+    import jax
+
+    if sharded:
+        @jax.jit
+        def f(shard, rows, idx):
+            # shard [1, T, cap] (one device's slice of every tenant)
+            return shard.at[0, idx, :].set(rows, mode="drop")
+    else:
+        @jax.jit
+        def f(block, rows, idx):
+            return block.at[idx, :].set(rows, mode="drop")
+    return f
+
+
+def _update_pack_rows(mesh, prev_dev, runs, dirty, S: int,
+                      t_bucket: int, cap: int, dtype):
+    """Ship only ``dirty`` slots' rows into the resident pack; returns
+    ``(device_array, shipped_bytes)``. Per device s, the replacement
+    block holds each dirty tenant's slice s (+inf padded to cap); the
+    scatter runs on that device's shard and the global array
+    reassembles from the surviving single-device pieces — exactly the
+    ``_reuse_rows`` protocol with a tenant axis."""
+    import jax
+    import jax.numpy as jnp
+
+    itemsize = np.dtype(dtype).itemsize
+    db = next_bucket(len(dirty), min_bucket=1)
+    idx = np.full(db, t_bucket, dtype=np.int32)     # padding: dropped
+    idx[: len(dirty)] = dirty
+
+    def dirty_rows(s: int) -> np.ndarray:
+        rows = np.full((db, cap), np.inf, dtype=dtype)
+        for i, t in enumerate(dirty):
+            r = runs[t] if t < len(runs) else ()
+            per = -(-len(r) // S) if len(r) else 0
+            chunk = r[s * per:(s + 1) * per]
+            if len(chunk):
+                rows[i, : len(chunk)] = chunk
+        return rows
+
+    if mesh is None:
+        fn = _pack_scatter_fn(t_bucket, cap, db, sharded=False)
+        dev = fn(prev_dev, jnp.asarray(dirty_rows(0)),
+                 jnp.asarray(idx))
+        return dev, db * cap * itemsize
+
+    from tuplewise_tpu.backends.mesh_backend import row_sharding
+
+    sharding = row_sharding(mesh)
+    by_row = {}
+    for sh in prev_dev.addressable_shards:
+        by_row[sh.index[0].start or 0] = sh
+    if sorted(by_row) != list(range(S)):
+        raise RuntimeError("previous pack does not cover the mesh")
+    fn = _pack_scatter_fn(t_bucket, cap, db, sharded=True)
+    pieces = []
+    for s in range(S):
+        rows_dev = jax.device_put(jnp.asarray(dirty_rows(s)),
+                                  by_row[s].device)
+        idx_dev = jax.device_put(jnp.asarray(idx), by_row[s].device)
+        pieces.append(fn(by_row[s].data, rows_dev, idx_dev))
+    dev = jax.make_array_from_single_device_arrays(
+        (S, t_bucket, cap), sharding, pieces)
+    return dev, S * db * cap * itemsize
 
 
 @functools.lru_cache(maxsize=None)

@@ -49,6 +49,13 @@ traffic is a *stream* of scored events, so this package adds:
                                  admission control + weighted-fair
                                  scheduling (``TenantRejectedError``),
                                  per-tenant windows/streams/WAL/SLOs.
+                                 Maintenance is O(changed) [ISSUE 9]:
+                                 dirty-row pack re-places, whale
+                                 promotion to a dedicated delta-tiered
+                                 index past ``whale_threshold``,
+                                 off-batcher tenant compaction, and a
+                                 ``tenant_metric_cap`` cardinality
+                                 bound.
 """
 
 from tuplewise_tpu.serving.engine import (

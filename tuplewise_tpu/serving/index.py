@@ -1312,6 +1312,42 @@ class ExactAucIndex:
         with self._cv:
             return self._pos.values(), self._neg.values()
 
+    # ------------------------------------------------------------------ #
+    # state transfer [ISSUE 9]                                           #
+    # ------------------------------------------------------------------ #
+    def seed_state(self, pos_vals, neg_vals, log, wins2: int,
+                   n_evicted: int = 0) -> None:
+        """Adopt an externally-maintained exact state: the sorted class
+        multisets become the base runs, the arrival log and integer
+        ``wins2`` carry over verbatim. Because every count is a pure
+        integer function of the current multiset, the index's future
+        outputs are bit-identical to the donor's would have been — the
+        whale-promotion handoff (``serving.tenancy``) relies on exactly
+        this. Call on a FRESH index (no events, no in-flight builds)."""
+        with self._cv:
+            self._pos.base = np.sort(
+                np.asarray(pos_vals, dtype=self.dtype))
+            self._neg.base = np.sort(
+                np.asarray(neg_vals, dtype=self.dtype))
+            self._log = collections.deque(log)
+            self._wins2 = int(wins2)
+            self.n_evicted = int(n_evicted)
+            for side in (self._pos, self._neg):
+                side.placed_base = None
+                self._place(side)
+                self._replace_deltas(side)
+            self._update_gauges()
+
+    def export_state(self) -> Tuple[np.ndarray, np.ndarray, list, int,
+                                    int]:
+        """The inverse handoff (demotion): ``(pos_sorted, neg_sorted,
+        log, wins2, n_evicted)`` of the current window. Consistent at
+        any time — the container invariant holds under the lock even
+        mid-background-build, and compaction never touches wins2."""
+        with self._cv:
+            return (self._pos.values(), self._neg.values(),
+                    list(self._log), self._wins2, self.n_evicted)
+
     def state(self) -> dict:
         with self._cv:
             return {

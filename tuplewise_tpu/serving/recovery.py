@@ -194,6 +194,82 @@ def _compat_config(config) -> dict:
     }
 
 
+def capture_index_arrays(idx, extra: dict, prefix: str = "") -> dict:
+    """Capture ONE exact index's containers into ``extra`` under
+    ``prefix`` and return its meta dict (wins2 as a decimal string —
+    it is an unbounded Python int — plus the lifecycle counters).
+    Factored out of :func:`capture_snapshot_state` in ISSUE 9 so the
+    fleet can snapshot a promoted whale tenant's delta-tiered index
+    through the SAME protocol the single-tenant engine uses."""
+    with idx._cv:
+        for name, side in (("pos", idx._pos), ("neg", idx._neg)):
+            # base arrays are rebound, never mutated in place
+            # (compaction swaps a NEW merged array in), so aliasing
+            # is a consistent capture with no O(n) copy
+            extra[f"{prefix}{name}_base"] = np.asarray(side.base,
+                                                       dtype=idx.dtype)
+            extra[f"{prefix}{name}_buf"] = np.asarray(side.buf,
+                                                      dtype=idx.dtype)
+            extra[f"{prefix}{name}_tomb"] = np.asarray(side.tomb,
+                                                       dtype=idx.dtype)
+            # delta-compaction state [ISSUE 5]: the host-
+            # authoritative consolidated delta run (plus its
+            # fold-trigger minor count) and the sorted tombstone
+            # multiset; device placements are a pure cache rebuilt
+            # on restore
+            extra[f"{prefix}{name}_delta_run"] = np.asarray(
+                side.delta_run, dtype=idx.dtype)
+            extra[f"{prefix}{name}_delta_minors"] = np.asarray(
+                [side.delta_minors], dtype=np.int64)
+            extra[f"{prefix}{name}_tomb_run"] = np.asarray(
+                side.tomb_run, dtype=idx.dtype)
+        extra[f"{prefix}log_scores"] = np.asarray(
+            [v for v, _ in idx._log], dtype=idx.dtype)
+        extra[f"{prefix}log_labels"] = np.asarray(
+            [p for _, p in idx._log], dtype=bool)
+        return {
+            "wins2": str(idx._wins2),
+            "n_compactions": idx.n_compactions,
+            "n_evicted": idx.n_evicted,
+            "n_major_merges": idx.n_major_merges,
+        }
+
+
+def restore_index_arrays(idx, extra: dict, meta: dict,
+                         prefix: str = "") -> None:
+    """Restore ONE exact index's containers from a capture made by
+    :func:`capture_index_arrays` (same ``prefix``), then rebuild the
+    device placements (a pure cache)."""
+    with idx._cv:
+        for name, side in (("pos", idx._pos), ("neg", idx._neg)):
+            side.base = extra[f"{prefix}{name}_base"].astype(idx.dtype)
+            side.buf = extra[f"{prefix}{name}_buf"].astype(
+                idx.dtype).tolist()
+            side.tomb = extra[f"{prefix}{name}_tomb"].astype(
+                idx.dtype).tolist()
+            # delta run + tombstone multiset [ISSUE 5]; absent in
+            # pre-delta snapshots (empty defaults keep them loadable)
+            dr = extra.get(f"{prefix}{name}_delta_run")
+            side.delta_run = (dr.astype(idx.dtype) if dr is not None
+                              else np.empty(0, dtype=idx.dtype))
+            dm = extra.get(f"{prefix}{name}_delta_minors")
+            side.delta_minors = int(dm[0]) if dm is not None else 0
+            tr = extra.get(f"{prefix}{name}_tomb_run")
+            side.tomb_run = (tr.astype(idx.dtype) if tr is not None
+                             else np.empty(0, dtype=idx.dtype))
+        idx._log = collections.deque(zip(
+            extra[f"{prefix}log_scores"].astype(idx.dtype).tolist(),
+            [bool(b) for b in extra[f"{prefix}log_labels"]]))
+        idx._wins2 = int(meta["wins2"])
+        idx.n_compactions = int(meta.get("n_compactions", 0))
+        idx.n_evicted = int(meta.get("n_evicted", 0))
+        idx.n_major_merges = int(meta.get("n_major_merges", 0))
+        for side in (idx._pos, idx._neg):
+            side.placed_base = None   # force a fresh placement
+            idx._place(side)
+            idx._replace_deltas(side)
+
+
 def capture_snapshot_state(engine) -> Tuple[dict, dict]:
     """The atomic handoff [ISSUE 4 satellite]: copy the engine's full
     estimator state into host arrays (cheap — no serialization, no
@@ -208,38 +284,7 @@ def capture_snapshot_state(engine) -> Tuple[dict, dict]:
     cfg = dict(_compat_config(engine.config))
     idx = engine.index
     if idx is not None:
-        with idx._cv:
-            for name, side in (("pos", idx._pos), ("neg", idx._neg)):
-                # base arrays are rebound, never mutated in place
-                # (compaction swaps a NEW merged array in), so aliasing
-                # is a consistent capture with no O(n) copy
-                extra[f"{name}_base"] = np.asarray(side.base,
-                                                   dtype=idx.dtype)
-                extra[f"{name}_buf"] = np.asarray(side.buf,
-                                                  dtype=idx.dtype)
-                extra[f"{name}_tomb"] = np.asarray(side.tomb,
-                                                   dtype=idx.dtype)
-                # delta-compaction state [ISSUE 5]: the host-
-                # authoritative consolidated delta run (plus its
-                # fold-trigger minor count) and the sorted tombstone
-                # multiset; device placements are a pure cache rebuilt
-                # on restore
-                extra[f"{name}_delta_run"] = np.asarray(
-                    side.delta_run, dtype=idx.dtype)
-                extra[f"{name}_delta_minors"] = np.asarray(
-                    [side.delta_minors], dtype=np.int64)
-                extra[f"{name}_tomb_run"] = np.asarray(
-                    side.tomb_run, dtype=idx.dtype)
-            extra["log_scores"] = np.asarray(
-                [v for v, _ in idx._log], dtype=idx.dtype)
-            extra["log_labels"] = np.asarray(
-                [p for _, p in idx._log], dtype=bool)
-            # wins2 is an unbounded Python int: a decimal string is the
-            # only exact serialization
-            cfg["wins2"] = str(idx._wins2)
-            cfg["n_compactions"] = idx.n_compactions
-            cfg["n_evicted"] = idx.n_evicted
-            cfg["n_major_merges"] = idx.n_major_merges
+        cfg.update(capture_index_arrays(idx, extra))
     st = engine.streaming
     extra["stream_sums"] = np.asarray([st._sum_h, st._sum_h2],
                                       dtype=np.float64)
@@ -282,35 +327,7 @@ def restore_snapshot(directory: str, engine) -> Optional[int]:
         _compat_config(engine.config))
     idx = engine.index
     if idx is not None and "pos_base" in extra:
-        with idx._cv:
-            for name, side in (("pos", idx._pos), ("neg", idx._neg)):
-                side.base = extra[f"{name}_base"].astype(idx.dtype)
-                side.buf = extra[f"{name}_buf"].astype(
-                    idx.dtype).tolist()
-                side.tomb = extra[f"{name}_tomb"].astype(
-                    idx.dtype).tolist()
-                # delta run + tombstone multiset [ISSUE 5]; absent in
-                # pre-delta snapshots (empty defaults keep them loadable)
-                dr = extra.get(f"{name}_delta_run")
-                side.delta_run = (dr.astype(idx.dtype)
-                                  if dr is not None
-                                  else np.empty(0, dtype=idx.dtype))
-                dm = extra.get(f"{name}_delta_minors")
-                side.delta_minors = int(dm[0]) if dm is not None else 0
-                tr = extra.get(f"{name}_tomb_run")
-                side.tomb_run = (tr.astype(idx.dtype) if tr is not None
-                                 else np.empty(0, dtype=idx.dtype))
-            idx._log = collections.deque(zip(
-                extra["log_scores"].astype(idx.dtype).tolist(),
-                [bool(b) for b in extra["log_labels"]]))
-            idx._wins2 = int(cfg["wins2"])
-            idx.n_compactions = int(cfg["n_compactions"])
-            idx.n_evicted = int(cfg["n_evicted"])
-            idx.n_major_merges = int(cfg.get("n_major_merges", 0))
-            for side in (idx._pos, idx._neg):
-                side.placed_base = None   # force a fresh placement
-                idx._place(side)
-                idx._replace_deltas(side)
+        restore_index_arrays(idx, extra, cfg)
     st = engine.streaming
     st._sum_h, st._sum_h2 = (float(x) for x in extra["stream_sums"])
     st._n_terms, st.n_arrivals = (int(x) for x in extra["stream_counts"])

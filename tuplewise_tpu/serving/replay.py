@@ -465,6 +465,10 @@ def replay_fleet(scores, labels, tenants,
             except BackpressureError:
                 dropped += 1
         wall = time.perf_counter() - t0
+        if cfg.bg_compact:
+            # settle in-flight background tenant builds OUTSIDE the
+            # timed window so byte/pause accounting is deterministic
+            eng.fleet.wait_idle()
         if flusher is not None:
             flusher.stop()
         stats = eng.stats()
@@ -525,6 +529,18 @@ def replay_fleet(scores, labels, tenants,
         "batches": m["batches_total"]["value"],
         "fleet_count_calls": m.get(
             "fleet_count_calls_total", {}).get("value", 0),
+        # incremental-placement byte budget [ISSUE 9]: the dirty-row
+        # saving the fleet_incremental bench cell prices
+        "bytes_h2d": m.get("bytes_h2d", {}).get("value", 0),
+        "bytes_h2d_saved": m.get("bytes_h2d_saved", {}).get("value", 0),
+        "pack_replaces": m.get(
+            "pack_replaces_total", {}).get("value", 0),
+        "pack_full_replaces": m.get(
+            "pack_full_replaces_total", {}).get("value", 0),
+        "whale_promotions": m.get(
+            "fleet_whale_promotions", {}).get("value", 0),
+        "whale_demotions": m.get(
+            "fleet_whale_demotions", {}).get("value", 0),
         "flight_events": flight_counts,
         "fleet": stats["fleet"],
         "config": {
@@ -534,6 +550,9 @@ def replay_fleet(scores, labels, tenants,
             "chunk": chunk, "max_tenants": ten_cfg.max_tenants,
             "tenant_quota": ten_cfg.tenant_quota,
             "weight": ten_cfg.weight,
+            "bg_compact": cfg.bg_compact,
+            "whale_threshold": ten_cfg.whale_threshold,
+            "tenant_metric_cap": ten_cfg.tenant_metric_cap,
         },
     }
     from tuplewise_tpu.obs.metrics_export import config_digest

@@ -56,12 +56,30 @@ trigger — so per-tenant results stay bit-identical to T independent
 single-tenant engines under any chaos schedule the single-tenant
 index survives.
 
-Deliberately NOT per-tenant (documented trade): delta compaction and
-background builds. Tenants are small by construction (the fleet's
-reason to exist), so a tenant compaction is an O(tenant) host splice —
-the delta machinery's O(buffer)-shipping advantage only pays at the
-single-giant-statistic scale, and per-tenant placement cost is bounded
-by the pack rebuild the compaction already triggers.
+**Incremental hot path** [ISSUE 9]: fleet maintenance is O(changed),
+not O(fleet). (1) *Dirty-row placement* — a compaction, drop, or slot
+reuse marks only the touched slots dirty and ``place_tenant_pack``
+ships only those rows into the resident device shards (the
+``place_base`` prev-trick generalized to the tenant axis); a re-place
+with 1 dirty tenant of 256 ships ~1/256 of the pack.
+(2) *Whale promotion* — the Zipf head that dominates real traffic
+outgrows the pack trade (an O(n) host splice per compaction): a tenant
+crossing ``whale_threshold`` live events transparently promotes to its
+own delta-tiered :class:`~tuplewise_tpu.serving.index.ExactAucIndex`
+(O(buffer) minors, tombstone evictions, on-mesh major merge) behind
+the same API, and demotes back on shrink. Promotion is statistically
+invisible — wins2 is a pure integer function of the event sequence, so
+per-tenant results stay bit-identical through any
+promote/demote/crash/recover interleaving (promotion state rides the
+snapshot manifest; WAL replay re-derives it deterministically).
+(3) *Off-batcher pack builds* — with ``bg_compact`` the per-tenant
+splice moves to a side compactor thread (the PR 2 double-buffer +
+atomic-swap protocol, tenant-granular): mutators only append to the
+unclaimed buffer suffix while a build runs, and the request path's
+worst pause is the swap. Small tenants still take the shared-pack
+route — the trade PR 8 documented — but the whale no longer drags the
+fleet, and metric cardinality is bounded (``tenant_metric_cap``
+collapses beyond-cap tenants into one ``{tenant=__other__}`` series).
 """
 
 from __future__ import annotations
@@ -120,8 +138,21 @@ class TenancyConfig:
         when the slot is reused.
       min_tenant_bucket: floor of the T_bucket compile-shape ladder.
       tenant_metrics: export per-tenant labeled metrics
-        (``insert_latency_s{tenant=}`` etc.). On by default; a
-        100k-tenant deployment would bound label cardinality here.
+        (``insert_latency_s{tenant=}`` etc.). On by default.
+      tenant_metric_cap: bound per-tenant metric cardinality
+        [ISSUE 9 satellite]: at most this many tenants get their own
+        labeled series; later tenants collapse into ONE
+        ``{tenant=__other__}`` series (first-come keeps its label —
+        stable, no re-labeling churn), so a 100k-tenant fleet cannot
+        blow up the registry, the MetricsFlusher rows, or the SLO
+        wildcard fan-out. None (default) = unbounded.
+      whale_threshold: promote a tenant to its own delta-tiered
+        ``ExactAucIndex`` once its live event count reaches this
+        [ISSUE 9 tentpole]; None (default) = never promote.
+      whale_demote_fraction: demote a promoted tenant once its live
+        event count shrinks below ``whale_threshold * fraction``
+        (hysteresis so a tenant oscillating at the threshold does not
+        thrash promote/demote).
     """
 
     max_tenants: int = 1024
@@ -130,6 +161,9 @@ class TenancyConfig:
     idle_evict_s: Optional[float] = None
     min_tenant_bucket: int = 8
     tenant_metrics: bool = True
+    tenant_metric_cap: Optional[int] = None
+    whale_threshold: Optional[int] = None
+    whale_demote_fraction: float = 0.5
 
     def __post_init__(self):
         if self.max_tenants < 1:
@@ -145,6 +179,18 @@ class TenancyConfig:
         if self.min_tenant_bucket < 1:
             raise ValueError(
                 f"min_tenant_bucket must be >= 1: {self.min_tenant_bucket}")
+        if self.tenant_metric_cap is not None \
+                and self.tenant_metric_cap < 1:
+            raise ValueError(
+                f"tenant_metric_cap must be >= 1: "
+                f"{self.tenant_metric_cap}")
+        if self.whale_threshold is not None and self.whale_threshold < 2:
+            raise ValueError(
+                f"whale_threshold must be >= 2: {self.whale_threshold}")
+        if not 0.0 <= self.whale_demote_fraction < 1.0:
+            raise ValueError(
+                f"whale_demote_fraction must be in [0, 1): "
+                f"{self.whale_demote_fraction}")
 
 
 def tenant_seed(base_seed: int, tid: str) -> int:
@@ -157,11 +203,23 @@ def tenant_seed(base_seed: int, tid: str) -> int:
 class _TenantStat:
     """One tenant's host-authoritative exact-AUC state: the
     single-tenant index's LSM containers, minus the device fields (the
-    fleet packs own those) and the delta tier (tenants are small)."""
+    fleet packs own those) and the delta tier.
+
+    ``idx`` is the whale escape hatch [ISSUE 9]: a promoted tenant's
+    state lives in its own :class:`ExactAucIndex` (containers here stay
+    empty, the pack row goes +inf) and every read/write routes there.
+
+    ``building`` + the per-side ``snap_*`` prefix lengths implement the
+    off-batcher compaction claim [ISSUE 9]: while a background build
+    owns a side's snapshotted prefixes, mutators only append to the
+    suffix and evictions only remove from it (else tombstone) — the
+    same double-buffer discipline as the single-tenant index."""
 
     __slots__ = ("tid", "slot", "pos_base", "neg_base", "pos_buf",
                  "neg_buf", "pos_tomb", "neg_tomb", "log", "wins2",
-                 "n_evicted", "n_compactions", "last_active")
+                 "n_evicted", "n_compactions", "last_active", "idx",
+                 "building", "snap_pos_buf", "snap_neg_buf",
+                 "snap_pos_tomb", "snap_neg_tomb")
 
     def __init__(self, tid: str, slot: int, dtype):
         self.tid = tid
@@ -177,11 +235,31 @@ class _TenantStat:
         self.n_evicted = 0
         self.n_compactions = 0
         self.last_active = time.monotonic()
+        self.idx = None             # promoted whale index [ISSUE 9]
+        self.building = False
+        self.snap_pos_buf = 0
+        self.snap_neg_buf = 0
+        self.snap_pos_tomb = 0
+        self.snap_neg_tomb = 0
 
     def side(self, pos: bool):
         if pos:
             return self.pos_base, self.pos_buf, self.pos_tomb
         return self.neg_base, self.neg_buf, self.neg_tomb
+
+    def snap(self, pos: bool) -> Tuple[int, int]:
+        """(buf, tomb) prefix lengths claimed by an in-flight build."""
+        if pos:
+            return self.snap_pos_buf, self.snap_pos_tomb
+        return self.snap_neg_buf, self.snap_neg_tomb
+
+    def pending(self) -> Tuple[int, int]:
+        """(buf, tomb) entries NOT already claimed by a build — what a
+        new compaction would consume."""
+        return (len(self.pos_buf) + len(self.neg_buf)
+                - self.snap_pos_buf - self.snap_neg_buf,
+                len(self.pos_tomb) + len(self.neg_tomb)
+                - self.snap_pos_tomb - self.snap_neg_tomb)
 
     def size(self, pos: bool) -> int:
         base, buf, tomb = self.side(pos)
@@ -196,15 +274,37 @@ class _TenantStat:
 
 
 class _Pack:
-    """One class side's shared device buffer + its placement geometry."""
+    """One class side's shared device buffer + its placement geometry.
 
-    __slots__ = ("dev", "cap", "t_bucket", "dirty")
+    ``dirty_slots`` tracks WHICH tenant rows changed since the resident
+    placement [ISSUE 9] — the next ``_ensure_packs`` ships only those
+    rows when the geometry allows; ``dirty_all`` (mesh change, restore,
+    T_bucket growth) forces the full ship. ``row_events`` records the
+    run length placed per slot — the occupancy/stale-row gauges read
+    it."""
+
+    __slots__ = ("dev", "cap", "t_bucket", "dirty_all", "dirty_slots",
+                 "row_events")
 
     def __init__(self):
         self.dev = None
         self.cap = 0
         self.t_bucket = 0
-        self.dirty = True
+        self.dirty_all = True
+        self.dirty_slots: set = set()
+        self.row_events: List[int] = []
+
+    @property
+    def dirty(self) -> bool:
+        return self.dirty_all or bool(self.dirty_slots)
+
+    def mark_all(self) -> None:
+        self.dirty_all = True
+        self.dirty_slots.clear()
+
+    def mark(self, slot: int) -> None:
+        if not self.dirty_all:
+            self.dirty_slots.add(slot)
 
 
 class TenantFleetIndex:
@@ -233,6 +333,10 @@ class TenantFleetIndex:
                  retry_backoff_s: float = 0.02,
                  probe_timeout_s: float = 5.0,
                  min_tenant_bucket: int = 8,
+                 bg_compact: bool = False,
+                 whale_threshold: Optional[int] = None,
+                 whale_demote_fraction: float = 0.5,
+                 incremental_placement: bool = True,
                  tracer=None, flight=None):
         if window is not None and window < 2:
             raise ValueError(f"window must be >= 2, got {window}")
@@ -242,10 +346,21 @@ class TenantFleetIndex:
             shards = int(np.prod(mesh.devices.shape))
         if shards is not None and shards < 1:
             raise ValueError(f"shards must be >= 1, got {shards}")
+        if whale_threshold is not None and whale_threshold < 2:
+            raise ValueError(
+                f"whale_threshold must be >= 2: {whale_threshold}")
         self.window = window
         self.compact_every = compact_every
         self.shards = shards
         self.min_tenant_bucket = min_tenant_bucket
+        self.bg_compact = bg_compact
+        self.whale_threshold = whale_threshold
+        self.whale_demote_fraction = whale_demote_fraction
+        # demotion hysteresis floor; 0 = only explicit demote()
+        self._demote_below = (
+            int(whale_threshold * whale_demote_fraction)
+            if whale_threshold is not None else 0)
+        self.incremental_placement = incremental_placement
         self.dtype = np.float32
         self.chaos = chaos
         self.shard_retries = shard_retries
@@ -262,6 +377,8 @@ class TenantFleetIndex:
         self._pos_pack = _Pack()
         self._neg_pack = _Pack()
         self._lock = threading.RLock()
+        # signals background-build completion (wait_idle drains on it)
+        self._cv = threading.Condition(self._lock)
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # ONE jitted batched count per coalesced multi-tenant batch —
         # this counter is the assertable witness [ISSUE 8 acceptance]
@@ -281,6 +398,23 @@ class TenantFleetIndex:
         self.metrics.counter("reshard_events")
         self.metrics.counter("shard_retries_total")
         self.metrics.histogram("recovery_time_s")
+        # incremental-placement accounting [ISSUE 9]: every pack
+        # (re)placement counts, full ships separately — the dirty-row
+        # saving is (replaces - full) with bytes_h2d_saved > 0
+        self._c_replaces = self.metrics.counter("pack_replaces_total")
+        self._c_full_replaces = self.metrics.counter(
+            "pack_full_replaces_total")
+        self._g_occupancy = self.metrics.gauge("pack_occupancy")
+        self._g_stale = self.metrics.gauge("pack_stale_rows")
+        # whale promotion lifecycle [ISSUE 9]
+        self._c_promotions = self.metrics.counter(
+            "fleet_whale_promotions")
+        self._c_demotions = self.metrics.counter("fleet_whale_demotions")
+        self._c_promote_aborts = self.metrics.counter(
+            "fleet_whale_promote_aborts")
+        self._g_whales = self.metrics.gauge("fleet_whales")
+        self._c_bg_restarts = self.metrics.counter(
+            "bg_compactor_restarts")
         self.last_compactor_error = None
         self._healer = None
         if shards is not None:
@@ -291,6 +425,16 @@ class TenantFleetIndex:
                 probe_timeout_s=probe_timeout_s, metrics=self.metrics,
                 backoff=Backoff(base_s=retry_backoff_s, cap_s=1.0),
                 tracer=tracer, flight=flight)
+        self._closed = False
+        if bg_compact:
+            import queue
+
+            self._jobs: "queue.Queue[Optional[_TenantStat]]" = \
+                queue.Queue()
+            self._compactor = threading.Thread(
+                target=self._compact_worker,
+                name="tuplewise-fleet-compactor", daemon=True)
+            self._compactor.start()
 
     # ------------------------------------------------------------------ #
     # tenant lifecycle                                                   #
@@ -319,8 +463,8 @@ class TenantFleetIndex:
                 return st
             if self._free:
                 slot = self._free.pop()
-                self._pos_pack.dirty = True
-                self._neg_pack.dirty = True
+                self._pos_pack.mark(slot)
+                self._neg_pack.mark(slot)
             else:
                 slot = len(self._slots)
                 self._slots.append(None)
@@ -334,20 +478,54 @@ class TenantFleetIndex:
             return st
 
     def drop(self, tid: str) -> bool:
-        """Remove a tenant; its slot is recycled. The stale pack row
-        is harmless (per-tenant rows are independent and the slot is
-        only queried again after a dirty re-place)."""
+        """Remove a tenant; its slot is recycled. The slot is marked
+        dirty in BOTH packs so the next placement reclaims its device
+        row (ships one +inf row) — before ISSUE 9 the stale row stayed
+        resident until the next full re-place, which the occupancy
+        gauges (and a shard-balance verdict reading them) miscounted
+        as live data."""
         with self._lock:
             st = self._by_tid.pop(tid, None)
             if st is None:
                 return False
+            if st.idx is not None:
+                st.idx.close()
+                st.idx = None
+                self._g_whales.set(self._n_whales())
             self._slots[st.slot] = None
             self._free.append(st.slot)
+            self._pos_pack.mark(st.slot)
+            self._neg_pack.mark(st.slot)
+            self._refresh_pack_gauges()
             self._g_tenants.set(len(self._by_tid))
             if self.flight is not None:
                 self.flight.record("tenant_evicted", tenant=tid,
                                    slot=st.slot, events=len(st.log))
             return True
+
+    def _n_whales(self) -> int:
+        return sum(1 for st in self._by_tid.values()
+                   if st.idx is not None)
+
+    def _refresh_pack_gauges(self) -> None:
+        """``pack_occupancy`` = device rows holding a LIVE tenant's
+        data; ``pack_stale_rows`` = rows still holding data whose slot
+        is no longer live (dropped/promoted, not yet reclaimed by a
+        re-place) — the truth a shard-balance verdict needs (caller
+        holds the lock) [ISSUE 9 satellite]."""
+        occ = stale = 0
+        for pack in (self._pos_pack, self._neg_pack):
+            for slot, n in enumerate(pack.row_events):
+                if not n:
+                    continue
+                st = (self._slots[slot]
+                      if slot < len(self._slots) else None)
+                if st is not None and st.idx is None:
+                    occ += 1
+                else:
+                    stale += 1
+        self._g_occupancy.set(occ)
+        self._g_stale.set(stale)
 
     def idle_tenants(self, idle_s: float) -> List[str]:
         now = time.monotonic()
@@ -367,7 +545,13 @@ class TenantFleetIndex:
     def _ensure_packs(self) -> None:
         """(Re)place dirty packs from the host-authoritative runs
         (caller holds the lock; runs inside the heal retry loop so a
-        placement onto a dead device heals like a count would)."""
+        placement onto a dead device heals like a count would).
+
+        Dirty-ROW path [ISSUE 9]: when only some slots changed and the
+        geometry is stable, ``place_tenant_pack`` ships just those
+        rows into the resident shards; a T_bucket change (or disabled
+        ``incremental_placement``) forces the full ship and counts it
+        in ``pack_full_replaces_total``."""
         from tuplewise_tpu.parallel.sharded_counts import place_tenant_pack
 
         tb = self._t_bucket()
@@ -376,16 +560,33 @@ class TenantFleetIndex:
                     and pack.t_bucket == tb:
                 continue
             runs = [(s.pos_base if pos else s.neg_base)
-                    if s is not None else np.empty(0, dtype=self.dtype)
+                    if s is not None and s.idx is None
+                    else np.empty(0, dtype=self.dtype)
                     for s in self._slots]
+            dirty = None
+            if (self.incremental_placement and not pack.dirty_all
+                    and pack.dev is not None and pack.t_bucket == tb):
+                dirty = sorted(pack.dirty_slots)
             with maybe_span(self.tracer, "fleet.place_pack",
                             side="pos" if pos else "neg",
-                            tenants=len(self._by_tid)):
-                pack.dev, pack.cap, _ = place_tenant_pack(
+                            tenants=len(self._by_tid),
+                            dirty=(len(dirty) if dirty is not None
+                                   else -1)):
+                pack.dev, pack.cap, shipped = place_tenant_pack(
                     self._mesh, runs, tb, self.dtype,
-                    metrics=self.metrics, chaos=self.chaos)
+                    prev=(pack.dev, pack.cap, pack.t_bucket),
+                    dirty=dirty, metrics=self.metrics,
+                    chaos=self.chaos)
+            full_bytes = ((self.shards or 1) * tb * pack.cap
+                          * np.dtype(self.dtype).itemsize)
+            self._c_replaces.inc()
+            if shipped >= full_bytes:
+                self._c_full_replaces.inc()
             pack.t_bucket = tb
-            pack.dirty = False
+            pack.dirty_all = False
+            pack.dirty_slots.clear()
+            pack.row_events = [len(r) for r in runs]
+        self._refresh_pack_gauges()
 
     def _on_heal(self, healer) -> None:
         """Adopt the (possibly narrower) healed mesh and rebuild the
@@ -393,8 +594,8 @@ class TenantFleetIndex:
         self._mesh = healer.mesh
         self.shards = healer.n_workers
         self._g_mesh.set(self.shards)
-        self._pos_pack.dirty = True
-        self._neg_pack.dirty = True
+        self._pos_pack.mark_all()
+        self._neg_pack.mark_all()
 
     def _fleet_base_counts(self, q_vs_neg: List[np.ndarray],
                            q_vs_pos: List[np.ndarray],
@@ -514,6 +715,9 @@ class TenantFleetIndex:
     def _apply_inserts_locked(self, items) -> List[int]:
         plans = []
         seen = set()
+        out_by_slot: Dict[int, int] = {}
+        order: List[int] = []
+        touched: List[_TenantStat] = []
         for tid, scores, labels in items:
             st = self._by_tid.get(tid)
             if st is None:
@@ -523,6 +727,8 @@ class TenantFleetIndex:
                     f"duplicate tenant {tid!r} in one apply — coalesce "
                     "per tenant first")
             seen.add(st.slot)
+            order.append(st.slot)
+            touched.append(st)
             scores = np.asarray(scores, dtype=self.dtype).ravel()
             labels = np.asarray(labels).ravel().astype(bool)
             if scores.shape != labels.shape:
@@ -531,6 +737,15 @@ class TenantFleetIndex:
                     f"{labels.shape}")
             if len(scores) and not np.all(np.isfinite(scores)):
                 raise ValueError("scores must be finite")
+            if st.idx is not None:
+                # whale route [ISSUE 9]: the promoted tenant's own
+                # delta-tiered index — O(log n) jitted counts, O(b)
+                # minors (off-thread under bg_compact), never the
+                # shared-pack splice
+                out_by_slot[st.slot] = st.idx.insert_batch(scores,
+                                                           labels)
+                st.last_active = time.monotonic()
+                continue
             p_new = scores[labels]
             n_new = scores[~labels]
             # window-eviction plan: the oldest overflow arrivals of
@@ -553,20 +768,48 @@ class TenantFleetIndex:
             n_out_arr = np.asarray(n_out, dtype=self.dtype)
             plans.append((st, scores, labels, p_new, n_new,
                           p_out_arr, n_out_arr, n_evict))
-        ln, lqn, lp, lqp = self._fleet_base_counts(
-            [np.concatenate([p[3], p[5]]) for p in plans],
-            [np.concatenate([p[4], p[6]]) for p in plans],
-            [p[0].slot for p in plans])
-        out = []
-        for i, plan in enumerate(plans):
-            out.append(self._fold_plan(plan, ln[i], lqn[i], lp[i], lqp[i]))
+        if plans:
+            ln, lqn, lp, lqp = self._fleet_base_counts(
+                [np.concatenate([p[3], p[5]]) for p in plans],
+                [np.concatenate([p[4], p[6]]) for p in plans],
+                [p[0].slot for p in plans])
+            for i, plan in enumerate(plans):
+                out_by_slot[plan[0].slot] = self._fold_plan(
+                    plan, ln[i], lqn[i], lp[i], lqp[i])
         for plan in plans:
-            st = plan[0]
-            if (len(st.pos_buf) + len(st.neg_buf) >= self.compact_every
-                    or len(st.pos_tomb) + len(st.neg_tomb)
-                    >= self.compact_every):
-                self._compact_tenant(st)
-        return out
+            self._maybe_compact(plan[0])
+        self._check_whales(touched)
+        return [out_by_slot[slot] for slot in order]
+
+    def _maybe_compact(self, st: _TenantStat) -> None:
+        """Trigger a tenant compaction when the UNCLAIMED buffer or
+        tombstone mass crosses the threshold (lock held). With
+        ``bg_compact`` the build is enqueued to the side compactor
+        [ISSUE 9]; a dead worker (crashed build) is restarted and the
+        trigger falls back to the synchronous splice this once — the
+        single-tenant watchdog discipline."""
+        buf_pending, tomb_pending = st.pending()
+        if (buf_pending < self.compact_every
+                and tomb_pending < self.compact_every):
+            return
+        if self.bg_compact:
+            if self._ensure_compactor():
+                self._submit_compact(st)
+                return
+        if not st.building:
+            self._compact_tenant(st)
+
+    def _check_whales(self, sts: List[_TenantStat]) -> None:
+        """Promote pack tenants crossing the threshold; demote whales
+        that shrank below the hysteresis floor (lock held)."""
+        if self.whale_threshold is None:
+            return
+        for st in sts:
+            if st.idx is None and len(st.log) >= self.whale_threshold:
+                self._promote(st)
+            elif (st.idx is not None
+                    and st.idx.n_events < self._demote_below):
+                self._demote(st)
 
     def _fold_plan(self, plan, less_n, leq_n, less_p, leq_p) -> int:
         """Apply one tenant's insert + eviction with host-exact
@@ -605,8 +848,14 @@ class TenantFleetIndex:
             for _ in range(n_evict):
                 v, is_pos = st.log.popleft()
                 buf = st.pos_buf if is_pos else st.neg_buf
+                snap_buf, _ = st.snap(is_pos)
                 try:
-                    buf.remove(v)
+                    # only the UNSNAPSHOTTED suffix is removable in
+                    # place: an in-flight background build owns the
+                    # prefix and will merge those copies into the new
+                    # base — tombstone instead [ISSUE 9]
+                    i = buf.index(v, snap_buf)
+                    buf.pop(i)
                 except ValueError:
                     (st.pos_tomb if is_pos else st.neg_tomb).append(v)
             st.n_evicted += n_evict
@@ -614,10 +863,12 @@ class TenantFleetIndex:
         return len(scores)
 
     def _compact_tenant(self, st: _TenantStat) -> None:
-        """Fold a tenant's buffers/tombstones into its sorted bases
-        and mark the packs for re-placement (lock held). A chaos-
-        injected crash aborts CLEANLY: containers untouched, wins2
-        never touched by compaction, retried at the next trigger."""
+        """Synchronous tenant compaction (lock held): fold the
+        buffers/tombstones into the sorted bases and mark THE SLOT
+        dirty in the touched packs — the next placement ships only
+        this tenant's rows [ISSUE 9]. A chaos-injected crash aborts
+        CLEANLY: containers untouched, wins2 never touched by
+        compaction, retried at the next trigger."""
         if self.chaos is not None:
             try:
                 self.chaos.fire("compactor_build")
@@ -640,10 +891,10 @@ class TenantFleetIndex:
                     list(tomb))
                 if pos:
                     st.pos_base, st.pos_buf, st.pos_tomb = merged, [], []
-                    self._pos_pack.dirty = True
+                    self._pos_pack.mark(st.slot)
                 else:
                     st.neg_base, st.neg_buf, st.neg_tomb = merged, [], []
-                    self._neg_pack.dirty = True
+                    self._neg_pack.mark(st.slot)
         st.n_compactions += 1
         self._c_compactions.inc()
         self._h_pause.observe(time.perf_counter() - t0)
@@ -654,46 +905,305 @@ class TenantFleetIndex:
                                + len(st.neg_base))
 
     # ------------------------------------------------------------------ #
+    # off-batcher pack builds [ISSUE 9]                                  #
+    # ------------------------------------------------------------------ #
+    def _ensure_compactor(self) -> bool:
+        """Watchdog (lock held): True when the side compactor thread is
+        alive; a dead worker (crashed build) is restarted and False
+        returned so the caller compacts synchronously this once."""
+        if not self.bg_compact:
+            return False
+        if self._compactor.is_alive():
+            return True
+        if not self._closed:
+            self._c_bg_restarts.inc()
+            self._compactor = threading.Thread(
+                target=self._compact_worker,
+                name="tuplewise-fleet-compactor", daemon=True)
+            self._compactor.start()
+        return False
+
+    def _submit_compact(self, st: _TenantStat) -> None:
+        """Claim the tenant's consumable prefixes and enqueue a build
+        (lock held); no-op while one is in flight."""
+        if st.building:
+            return
+        st.building = True
+        st.snap_pos_buf = len(st.pos_buf)
+        st.snap_neg_buf = len(st.neg_buf)
+        st.snap_pos_tomb = len(st.pos_tomb)
+        st.snap_neg_tomb = len(st.neg_tomb)
+        self._jobs.put(st)
+
+    def _compact_worker(self) -> None:
+        while True:
+            st = self._jobs.get()
+            if st is None:
+                return
+            try:
+                self._bg_build(st)
+            except BaseException as e:
+                # roll back the claim: buffers still hold every value
+                # (prefixes trim only at the swap) and wins2 was never
+                # touched — the next trigger re-compacts. The watchdog
+                # restarts the thread and counts it.
+                with self._cv:
+                    st.snap_pos_buf = st.snap_neg_buf = 0
+                    st.snap_pos_tomb = st.snap_neg_tomb = 0
+                    st.building = False
+                    self._c_compact_aborts.inc()
+                    self.last_compactor_error = repr(e)
+                    if self.flight is not None:
+                        self.flight.record("compaction_abort",
+                                           tenant=st.tid,
+                                           error=repr(e))
+                    self._cv.notify_all()
+                return
+
+    def _bg_build(self, st: _TenantStat) -> None:
+        """One off-batcher tenant build: splice the CLAIMED prefixes
+        into fresh bases with the lock released (inserts keep landing
+        in the suffix), then swap atomically and mark the slot dirty —
+        the request path's only pause is the swap [ISSUE 9]."""
+        if self.chaos is not None:
+            self.chaos.fire("compactor_build")
+        with self._cv:
+            pos_base, neg_base = st.pos_base, st.neg_base
+            buf_p = list(st.pos_buf[: st.snap_pos_buf])
+            buf_n = list(st.neg_buf[: st.snap_neg_buf])
+            tomb_p = list(st.pos_tomb[: st.snap_pos_tomb])
+            tomb_n = list(st.neg_tomb[: st.snap_neg_tomb])
+        with maybe_span(self.tracer, "fleet.bg_compact",
+                        tenant=st.tid,
+                        n_buf=len(buf_p) + len(buf_n)):
+            merged_p = _remove_sorted(
+                _splice_merge(pos_base, np.sort(
+                    np.asarray(buf_p, dtype=self.dtype))), tomb_p)
+            merged_n = _remove_sorted(
+                _splice_merge(neg_base, np.sort(
+                    np.asarray(buf_n, dtype=self.dtype))), tomb_n)
+        with self._cv:
+            t0 = time.perf_counter()
+            st.pos_base, st.neg_base = merged_p, merged_n
+            del st.pos_buf[: st.snap_pos_buf]
+            del st.neg_buf[: st.snap_neg_buf]
+            del st.pos_tomb[: st.snap_pos_tomb]
+            del st.neg_tomb[: st.snap_neg_tomb]
+            st.snap_pos_buf = st.snap_neg_buf = 0
+            st.snap_pos_tomb = st.snap_neg_tomb = 0
+            st.building = False
+            self._pos_pack.mark(st.slot)
+            self._neg_pack.mark(st.slot)
+            st.n_compactions += 1
+            self._c_compactions.inc()
+            # the swap is the only pause the request path can observe
+            self._h_pause.observe(time.perf_counter() - t0)
+            if self.flight is not None:
+                self.flight.record("compaction", tier="tenant_bg",
+                                   tenant=st.tid,
+                                   base_events=len(merged_p)
+                                   + len(merged_n))
+            buf_pending, tomb_pending = st.pending()
+            if (not self._closed
+                    and (buf_pending >= self.compact_every
+                         or tomb_pending >= self.compact_every)):
+                self._submit_compact(st)
+            self._cv.notify_all()
+
+    def wait_idle(self, timeout: float = 30.0) -> None:
+        """Block until no background tenant build is queued or in
+        flight (measurement code calls it so byte/pause accounting is
+        deterministic)."""
+        if not self.bg_compact:
+            return
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while any(st is not None and st.building
+                      for st in self._slots) or not self._jobs.empty():
+                self._ensure_compactor()
+                if (not self._cv.wait(timeout=0.25)
+                        and time.monotonic() >= deadline):
+                    raise TimeoutError("fleet background compaction "
+                                       "stuck")
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the side compactor and close every whale index."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.bg_compact:
+            self._jobs.put(None)
+            self._compactor.join(timeout=timeout)
+        with self._lock:
+            for st in self._by_tid.values():
+                if st.idx is not None:
+                    st.idx.close(timeout=timeout)
+
+    # ------------------------------------------------------------------ #
+    # whale promotion / demotion [ISSUE 9]                               #
+    # ------------------------------------------------------------------ #
+    def _make_whale_index(self):
+        """A dedicated delta-tiered exact index for one promoted
+        tenant: the PR 5 machinery (O(buffer) minors, tombstone
+        evictions, on-mesh major merge) on the fleet's mesh, sharing
+        the fleet's registry/chaos/observability hooks."""
+        from tuplewise_tpu.serving.index import ExactAucIndex
+
+        kw = dict(window=self.window, compact_every=self.compact_every,
+                  engine="jax", metrics=self.metrics, chaos=self.chaos,
+                  bg_compact=self.bg_compact,
+                  shard_retries=self.shard_retries,
+                  tracer=self.tracer, flight=self.flight)
+        if self._mesh is not None:
+            kw["mesh"] = self._mesh
+        return ExactAucIndex(**kw)
+
+    def promote(self, tid: str) -> bool:
+        """Explicitly promote a tenant (the automatic path triggers at
+        ``whale_threshold``); returns False when absent or already
+        promoted."""
+        with self._lock:
+            st = self._by_tid.get(tid)
+            if st is None or st.idx is not None:
+                return False
+            return self._promote(st)
+
+    def demote(self, tid: str) -> bool:
+        """Explicitly demote a promoted tenant back into the shared
+        pack (the automatic path triggers below the hysteresis
+        floor)."""
+        with self._lock:
+            st = self._by_tid.get(tid)
+            if st is None or st.idx is None:
+                return False
+            self._demote(st)
+            return True
+
+    def _promote(self, st: _TenantStat) -> bool:
+        """Move a pack tenant's state into its own index (lock held).
+        All fallible work — index construction, state seeding, device
+        placement — happens BEFORE the handoff, so a chaos fault
+        mid-promotion aborts cleanly (pack state untouched, counted in
+        ``fleet_whale_promote_aborts``) and the next trigger retries.
+        Statistically invisible: wins2/log transfer verbatim and every
+        count is a pure function of the multiset."""
+        # a build in flight owns the containers — promote next trigger
+        if st.building:
+            return False
+        idx = None
+        try:
+            idx = self._make_whale_index()
+            idx.seed_state(st.values(True), st.values(False),
+                           list(st.log), st.wins2,
+                           n_evicted=st.n_evicted)
+        except Exception as e:    # noqa: BLE001 — abort cleanly
+            self._c_promote_aborts.inc()
+            if self.flight is not None:
+                self.flight.record("whale_promote_abort", tenant=st.tid,
+                                   error=repr(e))
+            if idx is not None:
+                try:
+                    idx.close()
+                except Exception:     # noqa: BLE001 — best-effort
+                    pass
+            return False
+        st.idx = idx
+        st.pos_base = np.empty(0, dtype=self.dtype)
+        st.neg_base = np.empty(0, dtype=self.dtype)
+        st.pos_buf, st.neg_buf = [], []
+        st.pos_tomb, st.neg_tomb = [], []
+        st.log = collections.deque()
+        st.wins2 = 0
+        # reclaim the pack row (ships one +inf row at next placement)
+        self._pos_pack.mark(st.slot)
+        self._neg_pack.mark(st.slot)
+        self._c_promotions.inc()
+        self._g_whales.set(self._n_whales())
+        self._refresh_pack_gauges()
+        if self.flight is not None:
+            self.flight.record("whale_promoted", tenant=st.tid,
+                               events=idx.n_events)
+        return True
+
+    def _demote(self, st: _TenantStat) -> None:
+        """Fold a shrunken whale back into the shared pack (lock
+        held): the index's exact state transfers verbatim into the
+        tenant's containers, the slot re-places at the next count."""
+        idx = st.idx
+        pos, neg, log, wins2, n_evicted = idx.export_state()
+        st.idx = None
+        idx.close()
+        st.pos_base = np.asarray(pos, dtype=self.dtype)
+        st.neg_base = np.asarray(neg, dtype=self.dtype)
+        st.pos_buf, st.neg_buf = [], []
+        st.pos_tomb, st.neg_tomb = [], []
+        st.log = collections.deque(log)
+        st.wins2 = wins2
+        st.n_evicted = n_evicted
+        self._pos_pack.mark(st.slot)
+        self._neg_pack.mark(st.slot)
+        self._c_demotions.inc()
+        self._g_whales.set(self._n_whales())
+        self._refresh_pack_gauges()
+        if self.flight is not None:
+            self.flight.record("whale_demoted", tenant=st.tid,
+                               events=len(st.log))
+
+    # ------------------------------------------------------------------ #
     # queries                                                            #
     # ------------------------------------------------------------------ #
     def apply_scores(
         self, items: List[Tuple[str, np.ndarray]],
     ) -> List[np.ndarray]:
         """Fractional ranks vs each tenant's negatives for a coalesced
-        multi-tenant score batch — ONE jitted fleet count."""
+        multi-tenant score batch — ONE jitted fleet count (promoted
+        whales answer from their own index)."""
         with self._lock:
             plans = []
-            for tid, q in items:
+            out_by_pos: Dict[int, np.ndarray] = {}
+            for i, (tid, q) in enumerate(items):
                 st = self._by_tid.get(tid)
                 if st is None:
                     st = self.create(tid)
                 q = np.asarray(q, dtype=self.dtype).ravel()
-                plans.append((st, q))
-            empty = np.zeros(0, dtype=self.dtype)
-            ln, lqn, _, _ = self._fleet_base_counts(
-                [q for _, q in plans], [empty for _ in plans],
-                [st.slot for st, _ in plans])
-            out = []
-            for i, (st, q) in enumerate(plans):
-                n_neg = st.size(False)
-                if n_neg == 0:
-                    out.append(np.full(len(q), np.nan))
-                    continue
-                less, eq = self._host_adjust(q, ln[i], lqn[i],
-                                             st.neg_buf, st.neg_tomb)
-                out.append((less + 0.5 * eq) / float(n_neg))
-                st.last_active = time.monotonic()
-            return out
+                if st.idx is not None:
+                    out_by_pos[i] = st.idx.score_batch(q)
+                    st.last_active = time.monotonic()
+                else:
+                    plans.append((i, st, q))
+            if plans:
+                empty = np.zeros(0, dtype=self.dtype)
+                ln, lqn, _, _ = self._fleet_base_counts(
+                    [q for _, _, q in plans], [empty for _ in plans],
+                    [st.slot for _, st, _ in plans])
+                for k, (i, st, q) in enumerate(plans):
+                    n_neg = st.size(False)
+                    if n_neg == 0:
+                        out_by_pos[i] = np.full(len(q), np.nan)
+                        continue
+                    less, eq = self._host_adjust(
+                        q, ln[k], lqn[k], st.neg_buf, st.neg_tomb)
+                    out_by_pos[i] = (less + 0.5 * eq) / float(n_neg)
+                    st.last_active = time.monotonic()
+            return [out_by_pos[i] for i in range(len(items))]
+
+    def is_whale(self, tid: str) -> bool:
+        with self._lock:
+            st = self._by_tid.get(tid)
+            return st is not None and st.idx is not None
 
     def wins2(self, tid: str) -> int:
         with self._lock:
-            return self._by_tid[tid].wins2
+            st = self._by_tid[tid]
+            return st.idx._wins2 if st.idx is not None else st.wins2
 
     def auc(self, tid: str) -> Optional[float]:
         with self._lock:
             st = self._by_tid.get(tid)
             if st is None:
                 return None
+            if st.idx is not None:
+                return st.idx.auc()
             np_, nn = st.size(True), st.size(False)
             if np_ == 0 or nn == 0:
                 return None
@@ -702,6 +1212,8 @@ class TenantFleetIndex:
     def oracle_values(self, tid: str) -> Tuple[np.ndarray, np.ndarray]:
         with self._lock:
             st = self._by_tid[tid]
+            if st.idx is not None:
+                return st.idx.oracle_values()
             return st.values(True), st.values(False)
 
     def tenant_state(self, tid: str) -> Optional[dict]:
@@ -709,6 +1221,17 @@ class TenantFleetIndex:
             st = self._by_tid.get(tid)
             if st is None:
                 return None
+            if st.idx is not None:
+                return {
+                    "tenant": tid,
+                    "n_pos": st.idx.n_pos,
+                    "n_neg": st.idx.n_neg,
+                    "n_events": st.idx.n_events,
+                    "auc": st.idx.auc(),
+                    "n_compactions": st.idx.n_compactions,
+                    "n_evicted": st.idx.n_evicted,
+                    "promoted": True,
+                }
             return {
                 "tenant": tid,
                 "n_pos": st.size(True),
@@ -717,6 +1240,7 @@ class TenantFleetIndex:
                 "auc": self.auc(tid),
                 "n_compactions": st.n_compactions,
                 "n_evicted": st.n_evicted,
+                "promoted": False,
             }
 
     def state(self) -> dict:
@@ -730,6 +1254,10 @@ class TenantFleetIndex:
                 "pack_caps": {"pos": self._pos_pack.cap,
                               "neg": self._neg_pack.cap},
                 "count_calls": self._c_count_calls.value,
+                "whales": self._n_whales(),
+                "whale_threshold": self.whale_threshold,
+                "bg_compact": self.bg_compact,
+                "incremental_placement": self.incremental_placement,
                 "last_compactor_error": self.last_compactor_error,
             }
 
@@ -809,7 +1337,15 @@ class MultiTenantEngine:
             shards=config.mesh_shards, metrics=self.metrics,
             chaos=chaos,
             min_tenant_bucket=self.tenancy.min_tenant_bucket,
+            bg_compact=config.bg_compact,
+            whale_threshold=self.tenancy.whale_threshold,
+            whale_demote_fraction=self.tenancy.whale_demote_fraction,
             tracer=tracer, flight=self.flight)
+        # bounded metric cardinality [ISSUE 9 satellite]: tenants past
+        # tenant_metric_cap share ONE {tenant=__other__} series
+        self._labeled_tenants: set = set()
+        self._collapsed_tenants: set = set()
+        self._g_collapsed = self.metrics.gauge("tenant_metric_collapsed")
         self._streams: Dict[str, StreamingIncompleteU] = {}
         m = self.metrics
         self._c_req = {k: m.counter(f"requests_{k}_total")
@@ -856,6 +1392,26 @@ class MultiTenantEngine:
     # ------------------------------------------------------------------ #
     # tenant lifecycle                                                   #
     # ------------------------------------------------------------------ #
+    def _metric_tenant(self, tid: str) -> str:
+        """The label value a tenant's metrics use: its own id until
+        ``tenant_metric_cap`` distinct tenants are labeled, then
+        ``__other__`` [ISSUE 9 satellite]. First-come keeps its label
+        (stable — no re-labeling churn); the collapsed-tenant count
+        exports as the ``tenant_metric_collapsed`` gauge so doctor's
+        tenant breakdown can report how much the cap hid."""
+        cap = self.tenancy.tenant_metric_cap
+        if cap is None:
+            return tid
+        if tid in self._labeled_tenants:
+            return tid
+        if len(self._labeled_tenants) < cap:
+            self._labeled_tenants.add(tid)
+            return tid
+        if tid not in self._collapsed_tenants:
+            self._collapsed_tenants.add(tid)
+            self._g_collapsed.set(len(self._collapsed_tenants))
+        return "__other__"
+
     def _ensure_tenant(self, tid: str):
         """Create-on-first-request under the tenant cap (admission)."""
         if self.fleet.has(tid):
@@ -863,8 +1419,9 @@ class MultiTenantEngine:
         if self.fleet.n_tenants >= self.tenancy.max_tenants:
             self._c_tenant_rejected.inc()
             if self.tenancy.tenant_metrics:
-                self.metrics.counter("tenant_rejected_total",
-                                     labels={"tenant": tid}).inc()
+                self.metrics.counter(
+                    "tenant_rejected_total",
+                    labels={"tenant": self._metric_tenant(tid)}).inc()
             raise TenantRejectedError(
                 f"fleet at max_tenants={self.tenancy.max_tenants}; "
                 f"tenant {tid!r} not admitted", tenant=tid)
@@ -945,7 +1502,8 @@ class MultiTenantEngine:
                 if self.tenancy.tenant_metrics:
                     self.metrics.counter(
                         "tenant_rejected_total",
-                        labels={"tenant": tenant}).inc()
+                        labels={"tenant":
+                                self._metric_tenant(tenant)}).inc()
                 raise TenantRejectedError(
                     f"tenant {tenant!r} queue quota "
                     f"({self.tenancy.tenant_quota}) exceeded",
@@ -1185,7 +1743,8 @@ class MultiTenantEngine:
             h_tenant = None
             if self.tenancy.tenant_metrics:
                 h_tenant = self.metrics.histogram(
-                    "insert_latency_s", labels={"tenant": tid})
+                    "insert_latency_s",
+                    labels={"tenant": self._metric_tenant(tid)})
             for r in reqs:
                 if not r.future.done():
                     r.future.set_result(len(r.scores))
@@ -1262,6 +1821,7 @@ class MultiTenantEngine:
         self._fail_pending()
         if self._recovery is not None:
             self._recovery.checkpoint_and_close(self)
+        self.fleet.close(timeout=timeout)
         self.flight.record("engine_closed")
         self.flight.auto_dump()
 
@@ -1290,33 +1850,50 @@ def capture_fleet_snapshot_state(engine) -> Tuple[dict, dict]:
     """Consistent cut of EVERY tenant's state (batcher thread, fleet
     lock): containers + log as arrays keyed by a dense tenant index,
     wins2 (decimal strings) + RNG states + the tenant-id manifest in
-    the JSON config block."""
+    the JSON config block. Promoted whales [ISSUE 9] snapshot their
+    OWN index's containers through the shared single-index capture
+    (``recovery.capture_index_arrays``) under the same ``t{i}_``
+    prefix; the manifest's ``promoted`` flags + per-whale meta let the
+    restore rebuild the promotion state exactly."""
+    from tuplewise_tpu.serving.recovery import capture_index_arrays
     from tuplewise_tpu.utils.rng import capture_np_rng
 
     fleet = engine.fleet
     extra: dict = {}
     cfg = dict(_fleet_compat_config(engine.config, engine.tenancy))
     tids, wins2, rngs, counters = [], [], [], []
+    promoted, whale_meta = [], []
     with fleet._lock:
         for st in fleet._slots:
             if st is None:
                 continue
             i = len(tids)
             tids.append(st.tid)
-            wins2.append(str(st.wins2))
-            counters.append([st.n_evicted, st.n_compactions])
-            for name, pos in (("pos", True), ("neg", False)):
-                base, buf, tomb = st.side(pos)
-                extra[f"t{i}_{name}_base"] = np.asarray(base,
-                                                        dtype=fleet.dtype)
-                extra[f"t{i}_{name}_buf"] = np.asarray(buf,
-                                                       dtype=fleet.dtype)
-                extra[f"t{i}_{name}_tomb"] = np.asarray(tomb,
-                                                        dtype=fleet.dtype)
-            extra[f"t{i}_log_scores"] = np.asarray(
-                [v for v, _ in st.log], dtype=fleet.dtype)
-            extra[f"t{i}_log_labels"] = np.asarray(
-                [p for _, p in st.log], dtype=bool)
+            if st.idx is not None:
+                meta = capture_index_arrays(st.idx, extra,
+                                            prefix=f"t{i}_")
+                promoted.append(True)
+                whale_meta.append(meta)
+                wins2.append(meta["wins2"])
+                counters.append([meta["n_evicted"],
+                                 meta["n_compactions"]])
+            else:
+                promoted.append(False)
+                whale_meta.append(None)
+                wins2.append(str(st.wins2))
+                counters.append([st.n_evicted, st.n_compactions])
+                for name, pos in (("pos", True), ("neg", False)):
+                    base, buf, tomb = st.side(pos)
+                    extra[f"t{i}_{name}_base"] = np.asarray(
+                        base, dtype=fleet.dtype)
+                    extra[f"t{i}_{name}_buf"] = np.asarray(
+                        buf, dtype=fleet.dtype)
+                    extra[f"t{i}_{name}_tomb"] = np.asarray(
+                        tomb, dtype=fleet.dtype)
+                extra[f"t{i}_log_scores"] = np.asarray(
+                    [v for v, _ in st.log], dtype=fleet.dtype)
+                extra[f"t{i}_log_labels"] = np.asarray(
+                    [p for _, p in st.log], dtype=bool)
             stream = engine._streams[st.tid]
             extra[f"t{i}_stream_sums"] = np.asarray(
                 [stream._sum_h, stream._sum_h2], dtype=np.float64)
@@ -1332,6 +1909,8 @@ def capture_fleet_snapshot_state(engine) -> Tuple[dict, dict]:
     cfg["wins2"] = wins2
     cfg["tenant_counters"] = counters
     cfg["rng_states"] = rngs
+    cfg["promoted"] = promoted
+    cfg["whale_meta"] = whale_meta
     return extra, cfg
 
 
@@ -1348,26 +1927,46 @@ def restore_fleet_snapshot(directory: str, engine) -> Optional[int]:
     want = _fleet_compat_config(engine.config, engine.tenancy)
     check_config({k: cfg.get(k) for k in want}, want)
     fleet = engine.fleet
+    promoted = cfg.get("promoted") or [False] * len(cfg["tenants"])
+    whale_meta = cfg.get("whale_meta") or [None] * len(cfg["tenants"])
     with fleet._lock:
         for i, tid in enumerate(cfg["tenants"]):
             engine.create_tenant(tid)
             st = fleet._by_tid[tid]
-            for name, pos in (("pos", True), ("neg", False)):
-                base = extra[f"t{i}_{name}_base"].astype(fleet.dtype)
-                buf = extra[f"t{i}_{name}_buf"].astype(
-                    fleet.dtype).tolist()
-                tomb = extra[f"t{i}_{name}_tomb"].astype(
-                    fleet.dtype).tolist()
-                if pos:
-                    st.pos_base, st.pos_buf, st.pos_tomb = base, buf, tomb
-                else:
-                    st.neg_base, st.neg_buf, st.neg_tomb = base, buf, tomb
-            st.log = collections.deque(zip(
-                extra[f"t{i}_log_scores"].astype(fleet.dtype).tolist(),
-                [bool(b) for b in extra[f"t{i}_log_labels"]]))
-            st.wins2 = int(cfg["wins2"][i])
-            st.n_evicted, st.n_compactions = (
-                int(x) for x in cfg["tenant_counters"][i])
+            if promoted[i]:
+                # rebuild the whale's own index from its captured
+                # containers [ISSUE 9] — same restore the single-
+                # tenant engine runs, under the t{i}_ prefix
+                from tuplewise_tpu.serving.recovery import (
+                    restore_index_arrays,
+                )
+
+                idx = fleet._make_whale_index()
+                restore_index_arrays(idx, extra, whale_meta[i],
+                                     prefix=f"t{i}_")
+                st.idx = idx
+                fleet._g_whales.set(fleet._n_whales())
+            else:
+                for name, pos in (("pos", True), ("neg", False)):
+                    base = extra[f"t{i}_{name}_base"].astype(
+                        fleet.dtype)
+                    buf = extra[f"t{i}_{name}_buf"].astype(
+                        fleet.dtype).tolist()
+                    tomb = extra[f"t{i}_{name}_tomb"].astype(
+                        fleet.dtype).tolist()
+                    if pos:
+                        st.pos_base, st.pos_buf, st.pos_tomb = \
+                            base, buf, tomb
+                    else:
+                        st.neg_base, st.neg_buf, st.neg_tomb = \
+                            base, buf, tomb
+                st.log = collections.deque(zip(
+                    extra[f"t{i}_log_scores"].astype(
+                        fleet.dtype).tolist(),
+                    [bool(b) for b in extra[f"t{i}_log_labels"]]))
+                st.wins2 = int(cfg["wins2"][i])
+                st.n_evicted, st.n_compactions = (
+                    int(x) for x in cfg["tenant_counters"][i])
             stream = engine._streams[tid]
             stream._sum_h, stream._sum_h2 = (
                 float(x) for x in extra[f"t{i}_stream_sums"])
@@ -1379,8 +1978,8 @@ def restore_fleet_snapshot(directory: str, engine) -> Optional[int]:
                 res.items[:size] = extra[f"t{i}_{rname}_items"]
                 res.size, res.seen = size, seen
             restore_np_rng(stream._rng, cfg["rng_states"][i])
-        fleet._pos_pack.dirty = True
-        fleet._neg_pack.dirty = True
+        fleet._pos_pack.mark_all()
+        fleet._neg_pack.mark_all()
     return int(ck["step"])
 
 
