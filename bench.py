@@ -646,6 +646,189 @@ def _fleet_incremental_cell(n_events=40_000, tenants=256, skew=1.1,
     return out
 
 
+def _serving_kernel_cell(n_events=1_000_000, shards=2,
+                         fleet_tenants=256, fleet_events=40_000,
+                         skew=1.1, compact_every=1024, chunk=256,
+                         seed=0):
+    """Pallas-fused serving counts cell [ISSUE 10]: the same streams
+    driven through the index/fleet twice — XLA counts vs the fused
+    kernel (``count_kernel=True``) — at n=1e6, S=2 with delta tiers on
+    (windowed, so tombstones ride the kernel) and through the fleet at
+    T=256 Zipf 1.1. wins2 parity between the two engines is asserted
+    inline (integers: bit-exact, not approximate), and the record
+    carries the per-micro-batch dispatch-count witness — ONE kernel
+    invocation per device per micro-batch once the base runs are
+    placed. Off-TPU the kernel executes through the Pallas interpreter
+    (a Python-level emulation): the cell SHRINKS the stream and
+    records parity + dispatch counts, with the throughput claim gated
+    on TPU in ``p99_note`` per the established convention. Returns
+    None when the platform has fewer than ``shards`` devices."""
+    import jax
+
+    from tuplewise_tpu.serving import ExactAucIndex
+    from tuplewise_tpu.serving.replay import (
+        make_stream, make_tenant_stream,
+    )
+    from tuplewise_tpu.serving.tenancy import TenantFleetIndex
+
+    if jax.device_count() < shards:
+        print(f"[bench] serving_kernel skipped: {jax.device_count()} "
+              f"devices < {shards} shards", file=sys.stderr)
+        return None
+    interpret = jax.default_backend() != "tpu"
+    n_req, fleet_req = n_events, fleet_events
+    if interpret:
+        # interpret mode prices emulation, not silicon: shrink to the
+        # parity/dispatch-witness scale and say so in the record
+        n_events = min(n_events, 20_000)
+        fleet_events = min(fleet_events, 10_000)
+    scores, labels = make_stream(n_events, pos_frac=0.5,
+                                 separation=1.0, seed=seed)
+    scores = scores.astype(np.float32)
+    window = n_events // 2
+    out = {"n_events_requested": n_req, "n_events": n_events,
+           "shards": shards, "compact_every": compact_every,
+           "chunk": chunk, "window": window, "interpret": interpret}
+    wins = {}
+
+    def _drive(ck):
+        idx = ExactAucIndex(engine="jax", compact_every=compact_every,
+                            shards=shards, window=window,
+                            delta_fraction=0.25, count_kernel=ck)
+        # seed + place the base runs so the dispatch witness counts
+        # steady state (pre-placement batches legitimately need zero
+        # device dispatches)
+        idx.insert_batch(scores[:chunk], labels[:chunk])
+        idx.compact()
+        snap0 = idx.metrics.snapshot()
+        calls0 = snap0["count_kernel_calls_total"]["value"]
+        lats, batches = [], 0
+        t_all = time.perf_counter()
+        for i in range(chunk, n_events, chunk):
+            t0 = time.perf_counter()
+            idx.insert_batch(scores[i:i + chunk], labels[i:i + chunk])
+            lats.append(time.perf_counter() - t0)
+            batches += 1
+        wall = time.perf_counter() - t_all
+        snap = idx.metrics.snapshot()
+        lat = np.asarray(lats) * 1e3
+        rec = {
+            "wall_s": wall,
+            "events_per_s": (n_events - chunk) / wall,
+            "insert_latency_p50_ms": float(np.percentile(lat, 50)),
+            "insert_latency_p99_ms": float(np.percentile(lat, 99)),
+            "batches": batches,
+            "kernel_calls":
+                snap["count_kernel_calls_total"]["value"] - calls0,
+            "kernel_fallbacks":
+                snap["count_kernel_fallbacks_total"]["value"],
+        }
+        w2 = idx._wins2
+        idx.close()
+        return rec, w2
+
+    for mode, ck in (("xla", False), ("kernel", True)):
+        _drive(ck)                      # warmup: compiles off the clock
+        rec, w2 = _drive(ck)
+        out[mode] = rec
+        wins[mode] = w2
+        print(
+            f"[bench] serving_kernel [{mode}]: "
+            f"{rec['events_per_s']:.0f} ev/s "
+            f"insert p99={rec['insert_latency_p99_ms']:.2f}ms "
+            f"calls={rec['kernel_calls']}/{rec['batches']} batches "
+            f"fallbacks={rec['kernel_fallbacks']}", file=sys.stderr,
+        )
+    out["wins2_parity"] = wins["kernel"] == wins["xla"]
+    assert out["wins2_parity"], "serving_kernel parity broke"
+    out["kernel_calls_per_batch"] = round(
+        out["kernel"]["kernel_calls"] / out["kernel"]["batches"], 3)
+    assert out["kernel_calls_per_batch"] == 1.0, (
+        "fused path dispatched more than one kernel per micro-batch")
+    assert out["kernel"]["kernel_fallbacks"] == 0
+
+    # ------------------------------------------------------------- #
+    # fleet leg: T=256 Zipf 1.1 through the tenant-axis kernel       #
+    # ------------------------------------------------------------- #
+    fs, fl, ft = make_tenant_stream(fleet_events, fleet_tenants,
+                                    skew=skew, seed=seed)
+    fs = fs.astype(np.float32)
+
+    def _drive_fleet(ck):
+        fleet = TenantFleetIndex(compact_every=128, shards=shards,
+                                 count_kernel=ck)
+        applies = 0
+        lat_list = []
+        t_all = time.perf_counter()
+        for i in range(0, fleet_events, chunk):
+            sl = slice(i, min(i + chunk, fleet_events))
+            items = [(str(t), fs[sl][ft[sl] == t], fl[sl][ft[sl] == t])
+                     for t in np.unique(ft[sl])]
+            t0 = time.perf_counter()
+            fleet.apply_inserts(items)
+            lat_list.append(time.perf_counter() - t0)
+            applies += 1
+        wall = time.perf_counter() - t_all
+        snap = fleet.metrics.snapshot()
+        lat = np.asarray(lat_list) * 1e3
+        rec = {
+            "events_per_s": fleet_events / wall,
+            "insert_latency_p99_ms": float(np.percentile(lat, 99)),
+            "applies": applies,
+            "fleet_count_calls":
+                snap["fleet_count_calls_total"]["value"],
+            "kernel_calls": snap["count_kernel_calls_total"]["value"],
+            "kernel_fallbacks":
+                snap["count_kernel_fallbacks_total"]["value"],
+        }
+        w2 = {t: fleet.wins2(t) for t in fleet.tenants()}
+        fleet.close()
+        return rec, w2
+
+    fleet_out = {"tenants": fleet_tenants, "skew": skew,
+                 "n_events_requested": fleet_req,
+                 "n_events": fleet_events}
+    fwins = {}
+    for mode, ck in (("xla", False), ("kernel", True)):
+        _drive_fleet(ck)
+        rec, w2 = _drive_fleet(ck)
+        fleet_out[mode] = rec
+        fwins[mode] = w2
+        print(
+            f"[bench] serving_kernel fleet [{mode}]: "
+            f"{rec['events_per_s']:.0f} ev/s "
+            f"kernel_calls={rec['kernel_calls']} "
+            f"applies={rec['applies']}", file=sys.stderr,
+        )
+    fleet_out["wins2_parity"] = fwins["kernel"] == fwins["xla"]
+    assert fleet_out["wins2_parity"], "serving_kernel fleet parity broke"
+    assert (fleet_out["kernel"]["kernel_calls"]
+            == fleet_out["kernel"]["applies"]), (
+        "fleet fused path dispatched more than one kernel per batch")
+    out["fleet"] = fleet_out
+    # flat fields for scripts/perf_gate.py stage banding [ISSUE 10]
+    out["events_per_s"] = round(out["kernel"]["events_per_s"], 1)
+    out["insert_latency_p99_ms"] = out["kernel"][
+        "insert_latency_p99_ms"]
+    out["p99_note"] = (
+        "CPU caveat: off-TPU the kernel executes through the Pallas "
+        "INTERPRETER (per-grid-step Python emulation), so the "
+        "kernel-mode throughput/p99 here price the emulator, not the "
+        "fusion — the deliverables on CPU are the bit-exact parity "
+        "bits and kernel_calls_per_batch == 1.0 (one fused dispatch "
+        "per device per micro-batch vs the XLA path's per-run "
+        "searchsorted quartet + host tombstone pass); the throughput "
+        "claim is gated on TPU, where the compare-count kernel runs "
+        "the pallas_pairs grid at full VPU width"
+    )
+    print(
+        f"[bench] serving_kernel: parity=True calls/batch="
+        f"{out['kernel_calls_per_batch']} (interpret={interpret})",
+        file=sys.stderr,
+    )
+    return out
+
+
 def _streaming_main(args):
     import uuid
 
@@ -754,6 +937,16 @@ def _streaming_main(args):
             shards=args.fleet_bench_shards)
         if cell is not None:
             out["fleet_incremental"] = cell
+    if args.kernel_bench_n:
+        # Pallas-fused counts cell [ISSUE 10]: XLA vs kernel at
+        # n=1e6 S=2 delta tiers + fleet T=256 Zipf 1.1 (parity +
+        # one-dispatch witness; throughput claim gated on TPU)
+        cell = _serving_kernel_cell(
+            n_events=args.kernel_bench_n,
+            shards=args.kernel_bench_shards,
+            fleet_tenants=args.fleet_bench_tenants)
+        if cell is not None:
+            out["serving_kernel"] = cell
     print(json.dumps(out))
     if args.out:
         rows = [dict(out, stage="bench_streaming")]
@@ -767,6 +960,10 @@ def _streaming_main(args):
         if out.get("fleet_incremental"):
             rows.append(dict(out["fleet_incremental"],
                              stage="fleet_incremental", run_id=run_id,
+                             config_digest=out.get("config_digest")))
+        if out.get("serving_kernel"):
+            rows.append(dict(out["serving_kernel"],
+                             stage="serving_kernel", run_id=run_id,
                              config_digest=out.get("config_digest")))
         with open(args.out, "a", encoding="utf-8") as f:
             for r in rows:
@@ -818,6 +1015,14 @@ def main():
                          "0 skips it [ISSUE 9]")
     ap.add_argument("--fleet-bench-tenants", type=int, default=256)
     ap.add_argument("--fleet-bench-shards", type=int, default=2)
+    ap.add_argument("--kernel-bench-n", type=int, default=1_000_000,
+                    help="events for the Pallas-fused counts cell "
+                         "(XLA vs count_kernel=True at S=2 delta "
+                         "tiers + fleet T=256: bit parity, ONE kernel "
+                         "dispatch per device per micro-batch; "
+                         "auto-shrunk off-TPU where the kernel runs "
+                         "in interpret mode); 0 skips it [ISSUE 10]")
+    ap.add_argument("--kernel-bench-shards", type=int, default=2)
     ap.add_argument("--out", type=str, default=None,
                     help="with --streaming: also append the record "
                          "(and the delta cell) as JSONL rows, e.g. "

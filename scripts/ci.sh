@@ -26,15 +26,18 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
-# Sharded delta-compaction smoke [ISSUE 5]: the same replay on a
-# 2-device mesh, delta mode vs the host-merge engine — asserts
-# bit-identical AUC between the two engines (and vs the single-host
-# index's integer wins), plus a strict host->device byte saving per
-# minor compaction; writes results/serving_smoke_sharded.jsonl.
-timeout -k 10 240 env JAX_PLATFORMS=cpu \
+# Sharded delta-compaction smoke [ISSUE 5; --count-kernel leg
+# ISSUE 10]: the same replay on a 2-device mesh, delta mode vs the
+# host-merge engine — asserts bit-identical AUC between the two
+# engines (and vs the single-host index's integer wins), plus a strict
+# host->device byte saving per minor compaction. --count-kernel drives
+# a THIRD index through the Pallas-fused count path (interpret mode on
+# CPU) and asserts bit-identical wins2 at every step with zero kernel
+# fallbacks; writes results/serving_smoke_sharded.jsonl.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python scripts/streaming_smoke.py --mesh-shards 2 \
-    --delta-fraction 0.25 --n-events 6000 \
+    --delta-fraction 0.25 --n-events 6000 --count-kernel \
     --out results/serving_smoke_sharded.jsonl
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
@@ -47,11 +50,14 @@ if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 # typed quota shedding, PLUS the whale leg: one tenant at ~20x the
 # median promotes (fleet_whale_promotions fired), parity holds through
 # the promotion, and dirty-row placement ships strictly less than the
-# full pack per re-place; writes results/multitenant_smoke.jsonl for
-# the CI artifact.
-timeout -k 10 300 env JAX_PLATFORMS=cpu \
+# full pack per re-place. --count-kernel [ISSUE 10] re-runs the
+# fleet-vs-independents parity through the Pallas tenant-axis count
+# kernel (interpret mode) asserting bit-identical wins2/AUC and zero
+# fallbacks; writes results/multitenant_smoke.jsonl for the CI
+# artifact.
+timeout -k 10 360 env JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python scripts/multitenant_smoke.py
+    python scripts/multitenant_smoke.py --count-kernel
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
@@ -116,9 +122,10 @@ if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 # Perf gate [ISSUE 7, fail since ISSUE 8, multi-stage since ISSUE 9]:
 # the newest row of EACH gated stage (bench_streaming, multi_tenant,
 # fleet_incremental — the last adds bytes-per-pack-re-place so the
-# dirty-row saving can never quietly regress) in the committed
-# results/serving.jsonl vs its comparable history, with noise bands;
-# any stage breach fails CI.
+# dirty-row saving can never quietly regress — and serving_kernel
+# [ISSUE 10], whose kernel_calls_per_batch witness must hold at
+# exactly 1.0) in the committed results/serving.jsonl vs its
+# comparable history, with noise bands; any stage breach fails CI.
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
     python scripts/perf_gate.py --mode fail
 exit $?

@@ -55,12 +55,14 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "results", "multitenant_smoke.jsonl")
 
 
-def fleet_vs_independent():
-    """Direct index parity: the fleet vs T dedicated engines."""
+def fleet_vs_independent(count_kernel=False):
+    """Direct index parity: the fleet vs T dedicated engines. With
+    ``count_kernel`` the fleet counts run through the Pallas tenant-
+    axis kernel (interpret mode on CPU) [ISSUE 10]."""
     scores, labels, tenants = make_tenant_stream(
         N_EVENTS, T, skew=1.0, seed=7)
     fleet = TenantFleetIndex(window=256, compact_every=64,
-                             shards=SHARDS)
+                             shards=SHARDS, count_kernel=count_kernel)
     singles = {}
     # coalesced multi-tenant batches: chunk the stream, group by tenant
     chunk = 97
@@ -83,9 +85,18 @@ def fleet_vs_independent():
                 or fleet.auc(str(tid)) != idx.auc():
             mismatches.append(str(tid))
     assert not mismatches, f"fleet/independent mismatch: {mismatches}"
-    return {"tenants": len(singles),
-            "count_calls": fleet.state()["count_calls"],
-            "parity": "bit-identical"}
+    out = {"tenants": len(singles),
+           "count_calls": fleet.state()["count_calls"],
+           "parity": "bit-identical"}
+    if count_kernel:
+        snap = fleet.metrics.snapshot()
+        calls = snap["count_kernel_calls_total"]["value"]
+        fallbacks = snap["count_kernel_fallbacks_total"]["value"]
+        assert calls >= 1, "count kernel never dispatched"
+        assert fallbacks == 0, f"count kernel fell back {fallbacks}x"
+        out["kernel_calls"] = int(calls)
+        out["kernel_fallbacks"] = int(fallbacks)
+    return out
 
 
 def engine_leg():
@@ -206,12 +217,27 @@ def whale_leg():
             "parity": "bit-identical"}
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--count-kernel", action="store_true",
+                    help="add the Pallas-fused counts leg [ISSUE 10]: "
+                         "re-run the fleet-vs-independents parity with "
+                         "count_kernel=True (interpret mode on CPU) "
+                         "and assert bit-identical wins2/AUC + zero "
+                         "kernel fallbacks")
+    args = ap.parse_args(argv)
+
     rec = {"stage": "multitenant_smoke", "tenants": T,
            "mesh_shards": SHARDS, "n_events": N_EVENTS}
     rec["independent_parity"] = fleet_vs_independent()
     print(f"[multitenant_smoke] index parity OK "
           f"({rec['independent_parity']})", file=sys.stderr)
+    if args.count_kernel:
+        rec["count_kernel"] = fleet_vs_independent(count_kernel=True)
+        print(f"[multitenant_smoke] count-kernel leg OK "
+              f"({rec['count_kernel']})", file=sys.stderr)
     rec["engine"] = engine_leg()
     print(f"[multitenant_smoke] engine leg OK ({rec['engine']})",
           file=sys.stderr)

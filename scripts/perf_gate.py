@@ -86,8 +86,19 @@ _STAGE_METRICS = {
         ("insert_latency_p99_ms", "min", "insert_latency_p99_ms"),
         ("bytes_per_replace", "min", "bytes_per_replace"),
     ),
+    # Pallas-fused counts [ISSUE 10]: kernel-mode throughput/p99 band
+    # against their own history (interpret-mode numbers on CPU CI —
+    # the emulator regressing IS a regression worth hearing about),
+    # and the one-dispatch-per-micro-batch witness must stay exactly
+    # 1.0 (any drift means the fusion quietly split)
+    "serving_kernel": (
+        ("events_per_s", "max", "events_per_s"),
+        ("insert_latency_p99_ms", "min", "insert_latency_p99_ms"),
+        ("kernel_calls_per_batch", "min", "kernel_calls_per_batch"),
+    ),
 }
-_DEFAULT_STAGES = "bench_streaming,multi_tenant,fleet_incremental"
+_DEFAULT_STAGES = ("bench_streaming,multi_tenant,fleet_incremental,"
+                   "serving_kernel")
 
 # the config fields that make two bench_streaming rows comparable when
 # no config_digest is stamped (pre-ISSUE-7 history)

@@ -107,7 +107,10 @@ def _sharded_delta_leg(args) -> int:
         return 1
     # ... and a directly-driven delta index must match the SINGLE-HOST
     # index's integer win count exactly (windowed, so tombstones +
-    # deltas + a major merge are all exercised)
+    # deltas + a major merge are all exercised). With --count-kernel a
+    # THIRD index rides the same stream through the Pallas-fused count
+    # path (interpret mode on CPU) and must match bit-for-bit at every
+    # step [ISSUE 10 satellite].
     sc32 = scores.astype(np.float32)
     w = max(256, n_events // 3)
     sharded = ExactAucIndex(engine="jax", compact_every=128, window=w,
@@ -115,6 +118,13 @@ def _sharded_delta_leg(args) -> int:
                             delta_fraction=args.delta_fraction,
                             max_delta_runs=args.max_delta_runs)
     single = ExactAucIndex(engine="jax", compact_every=128, window=w)
+    kernel = None
+    if args.count_kernel:
+        kernel = ExactAucIndex(engine="jax", compact_every=128,
+                               window=w, shards=args.mesh_shards,
+                               delta_fraction=args.delta_fraction,
+                               max_delta_runs=args.max_delta_runs,
+                               count_kernel=True)
     for i in range(0, len(sc32), 173):
         j = min(i + 173, len(sc32))
         sharded.insert_batch(sc32[i:j], labels[i:j])
@@ -123,6 +133,27 @@ def _sharded_delta_leg(args) -> int:
             print(f"SMOKE FAIL: wins2 diverged at event {j}",
                   file=sys.stderr)
             return 1
+        if kernel is not None:
+            kernel.insert_batch(sc32[i:j], labels[i:j])
+            if kernel._wins2 != single._wins2:
+                print(f"SMOKE FAIL: count-kernel wins2 diverged at "
+                      f"event {j}", file=sys.stderr)
+                return 1
+    if kernel is not None:
+        ksnap = kernel.metrics.snapshot()
+        calls = ksnap["count_kernel_calls_total"]["value"]
+        fallbacks = ksnap["count_kernel_fallbacks_total"]["value"]
+        kernel.close()
+        if calls < 1 or fallbacks:
+            print(f"SMOKE FAIL: count kernel calls={calls} "
+                  f"fallbacks={fallbacks} (expected active kernel, "
+                  f"zero fallbacks)", file=sys.stderr)
+            return 1
+        delta["count_kernel"] = {"calls": int(calls),
+                                 "fallbacks": int(fallbacks),
+                                 "parity": "bit-identical"}
+        print(f"count-kernel leg OK: {calls} fused dispatches, "
+              f"0 fallbacks, wins2 bit-identical", file=sys.stderr)
     # the byte saving the tier exists for [ISSUE 5]
     if not delta["bytes_h2d"]:
         print("SMOKE FAIL: delta mode recorded zero bytes_h2d",
@@ -157,6 +188,11 @@ def main(argv=None) -> int:
                          "N-device mesh instead of the plain smoke")
     ap.add_argument("--delta-fraction", type=float, default=0.25)
     ap.add_argument("--max-delta-runs", type=int, default=64)
+    ap.add_argument("--count-kernel", action="store_true",
+                    help="also drive the Pallas-fused count path "
+                         "(interpret mode on CPU) and assert "
+                         "bit-identical wins2 vs the XLA path "
+                         "[ISSUE 10]")
     ap.add_argument("--out", type=str,
                     default=os.path.join(REPO, "results",
                                          "serving_smoke.jsonl"))
@@ -178,6 +214,24 @@ def main(argv=None) -> int:
     rc = _check_common(rec)
     if rc:
         return rc
+    if args.count_kernel:
+        # kernel leg [ISSUE 10]: same stream through the engine with
+        # the fused count path on — the exact statistic must be
+        # bit-identical (integer counts)
+        import dataclasses
+
+        krec = replay(scores, labels,
+                      config=dataclasses.replace(cfg,
+                                                 count_kernel=True),
+                      max_inflight=256)
+        if krec["auc_exact"] != rec["auc_exact"]:
+            print(f"SMOKE FAIL: count-kernel AUC mismatch "
+                  f"{krec['auc_exact']} != {rec['auc_exact']}",
+                  file=sys.stderr)
+            return 1
+        rec["count_kernel"] = {"parity": "bit-identical"}
+        print("count-kernel leg OK: engine AUC bit-identical",
+              file=sys.stderr)
     _write(rec, args.out)
     print(
         f"streaming smoke OK: {rec['events_per_s']:.0f} ev/s, insert "

@@ -16,10 +16,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_streaming_bench_emits_one_json_line():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the heavy side cells (delta bytes, multi-tenant, incremental
+    # fleet, count kernel) are disabled here: this test pins the
+    # STDOUT CONTRACT of the headline streaming record, and every
+    # cell's substance has its own dedicated suite
+    # (test_sharded_index / test_tenancy / test_fleet_incremental /
+    # test_pallas_counts) plus the CI smokes — re-running them in a
+    # subprocess cost ~2 minutes of tier-1 budget for zero added
+    # coverage [ISSUE 10 satellite]
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--streaming",
          "--n-events", "400", "--baseline-events", "100",
-         "--max-batch", "32"],
+         "--max-batch", "32", "--delta-bench-n", "0",
+         "--tenant-bench-n", "0", "--fleet-bench-n", "0",
+         "--kernel-bench-n", "0"],
         capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]

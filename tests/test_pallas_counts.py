@@ -1,0 +1,522 @@
+"""Pallas-fused serving counts [ISSUE 10]: kernel-vs-XLA bit-exact
+parity (integers, so parity is equality — not tolerance), the
+one-invocation-per-micro-batch witness, automatic XLA fallback on
+kernel failure, chaos heal with the kernel on, compile-cache growth
+bounded by the (T_bucket, cap, q_bucket) ladder, and recovery
+bit-identity. CPU runs execute the kernel through the Pallas
+interpreter (TUPLEWISE_SERVING_PALLAS / count_kernel resolve to
+interpret mode off-TPU)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.ops import pallas_counts
+from tuplewise_tpu.parallel import sharded_counts as sc
+from tuplewise_tpu.serving.index import ExactAucIndex
+from tuplewise_tpu.serving.tenancy import TenantFleetIndex
+from tuplewise_tpu.testing.chaos import FaultInjector
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_state():
+    """Each test starts with no latched-broken geometries and no
+    forced-failure hook."""
+    sc._KERNEL_BROKEN.clear()
+    pallas_counts.FORCE_FAIL = False
+    yield
+    sc._KERNEL_BROKEN.clear()
+    pallas_counts.FORCE_FAIL = False
+
+
+def _stream(n, seed=0, sep=0.8, dup_every=13):
+    rng = np.random.default_rng(seed)
+    labels = rng.random(n) < 0.5
+    scores = (rng.standard_normal(n) + sep * labels).astype(np.float32)
+    # duplicated values exercise the left/right tie boundaries the
+    # +inf-padded searchsorted contract depends on
+    scores[::dup_every] = np.round(scores[::dup_every], 1)
+    return scores, labels
+
+
+class TestSignedPairCounts:
+    """The dispatcher primitive against a NumPy searchsorted oracle."""
+
+    def _oracle(self, runs, q):
+        less = np.zeros(len(q), np.int64)
+        leq = np.zeros(len(q), np.int64)
+        for arr, sign in runs:
+            less += sign * np.searchsorted(arr, q, side="left")
+            leq += sign * np.searchsorted(arr, q, side="right")
+        return less, leq
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_randomized_signed_parity_local(self, seed):
+        rng = np.random.default_rng(seed)
+        base = np.sort(rng.standard_normal(
+            int(rng.integers(1, 400)))).astype(np.float32)
+        delta = np.sort(rng.standard_normal(
+            int(rng.integers(0, 60)))).astype(np.float32)
+        tomb = np.sort(rng.choice(
+            base, int(rng.integers(0, min(10, len(base)))),
+            replace=False)).astype(np.float32)
+        q = rng.standard_normal(int(rng.integers(1, 50))).astype(
+            np.float32)
+        q[: min(3, len(q))] = base[: min(3, len(q))]   # boundary ties
+        runs = [(a, sc.next_bucket(len(a)), s)
+                for a, s in ((base, 1), (delta, 1), (tomb, -1))
+                if len(a)]
+        less, leq, _, _ = sc.signed_pair_counts(
+            None, runs, (), q, np.zeros(0, np.float32), np.float32,
+            kernel=True)
+        ol, oq = self._oracle(
+            [(a, s) for a, s in ((base, 1), (delta, 1), (tomb, -1))
+             if len(a)], q)
+        assert np.array_equal(less, ol)
+        assert np.array_equal(leq, oq)
+
+    def test_two_query_sets_one_dispatch(self):
+        rng = np.random.default_rng(7)
+        neg = np.sort(rng.standard_normal(300)).astype(np.float32)
+        pos = np.sort(rng.standard_normal(200)).astype(np.float32)
+        qa = rng.standard_normal(17).astype(np.float32)
+        qb = rng.standard_normal(9).astype(np.float32)
+        la, lqa, lb, lqb = sc.signed_pair_counts(
+            None, [(neg, 512, 1)], [(pos, 256, 1)], qa, qb,
+            np.float32, kernel=True)
+        assert np.array_equal(la, np.searchsorted(neg, qa, "left"))
+        assert np.array_equal(lqa, np.searchsorted(neg, qa, "right"))
+        assert np.array_equal(lb, np.searchsorted(pos, qb, "left"))
+        assert np.array_equal(lqb, np.searchsorted(pos, qb, "right"))
+
+    def test_xla_twin_matches_kernel(self):
+        """The fallback target is bit-identical to the kernel — the
+        property that makes the automatic fallback invisible."""
+        rng = np.random.default_rng(11)
+        base = np.sort(rng.standard_normal(500)).astype(np.float32)
+        tomb = np.sort(rng.choice(base, 20, replace=False)).astype(
+            np.float32)
+        q = rng.standard_normal(40).astype(np.float32)
+        runs = [(base, 512, 1), (tomb, 256, -1)]
+        a = sc.signed_pair_counts(None, runs, (), q,
+                                  np.zeros(0, np.float32), np.float32,
+                                  kernel=True)
+        b = sc.signed_pair_counts(None, runs, (), q,
+                                  np.zeros(0, np.float32), np.float32,
+                                  kernel=None)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)
+
+
+class TestIndexKernelParity:
+    """ExactAucIndex(count_kernel=True) vs the stock XLA index —
+    wins2/AUC/score ranks bit-identical at every step."""
+
+    @pytest.mark.parametrize("shards,window", [
+        (None, None), (None, 120), (1, 120), (2, None), (2, 150),
+        (4, 100),
+    ])
+    def test_bit_identical_stream(self, shards, window):
+        scores, labels = _stream(500, seed=3)
+        kw = dict(engine="jax", compact_every=48, window=window)
+        if shards is not None:
+            kw.update(shards=shards, delta_fraction=0.25,
+                      max_delta_runs=3)
+        xla = ExactAucIndex(**kw)
+        ker = ExactAucIndex(count_kernel=True, **kw)
+        sizes = [67, 1, 33, 0, 128, 97, 174]
+        i = 0
+        for step, sz in enumerate(sizes * 2):
+            j = min(i + sz, len(scores))
+            xla.insert_batch(scores[i:j], labels[i:j])
+            ker.insert_batch(scores[i:j], labels[i:j])
+            i = j
+            assert xla._wins2 == ker._wins2, (shards, window, step)
+            assert xla.auc() == ker.auc()
+            q = scores[max(0, j - 9):j]
+            assert np.array_equal(
+                np.nan_to_num(xla.score_batch(q)),
+                np.nan_to_num(ker.score_batch(q)))
+        # the kernel actually ran, and never fell back
+        snap = ker.metrics.snapshot()
+        assert snap["count_kernel_calls_total"]["value"] > 0
+        assert snap["count_kernel_fallbacks_total"]["value"] == 0
+        # and the multisets agree (tombstones included)
+        for a, b in zip(xla.oracle_values(), ker.oracle_values()):
+            assert np.array_equal(a, b)
+        xla.close()
+        ker.close()
+
+    def test_full_compact_and_empty_cases(self):
+        """compact() clears delta + tombstone runs (and the kernel's
+        tombstone mirror); counting stays exact through empty-delta /
+        empty-tombstone geometries."""
+        scores, labels = _stream(400, seed=9)
+        xla = ExactAucIndex(engine="jax", compact_every=32, window=90,
+                            shards=2, max_delta_runs=2)
+        ker = ExactAucIndex(engine="jax", compact_every=32, window=90,
+                            shards=2, max_delta_runs=2,
+                            count_kernel=True)
+        for i in range(0, 400, 57):
+            j = min(i + 57, 400)
+            xla.insert_batch(scores[i:j], labels[i:j])
+            ker.insert_batch(scores[i:j], labels[i:j])
+            if i and i % 114 == 0:
+                xla.compact()
+                ker.compact()
+            assert xla._wins2 == ker._wins2, i
+        assert ker._pos.tomb_dev is None or len(ker._pos.tomb_run)
+        xla.close()
+        ker.close()
+
+    def test_one_kernel_call_per_insert_batch(self):
+        """The tentpole witness: one fused invocation per insert
+        micro-batch — eviction queries ride the insert dispatch."""
+        scores, labels = _stream(360, seed=13)
+        ker = ExactAucIndex(engine="jax", compact_every=1000,
+                            window=100, shards=2, count_kernel=True)
+        # seed + place the base runs (before any placement exists, a
+        # batch legitimately needs ZERO device dispatches — everything
+        # counts against the host buffer)
+        ker.insert_batch(scores[:45], labels[:45])
+        ker.compact()
+        before = ker.metrics.snapshot()[
+            "count_kernel_calls_total"]["value"]
+        n_batches = 0
+        for i in range(45, 360, 45):
+            ker.insert_batch(scores[i:i + 45], labels[i:i + 45])
+            n_batches += 1
+        calls = ker.metrics.snapshot()[
+            "count_kernel_calls_total"]["value"] - before
+        assert calls == n_batches, (calls, n_batches)
+        ker.close()
+
+    def test_env_off_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("TUPLEWISE_SERVING_PALLAS", "off")
+        idx = ExactAucIndex(engine="jax", shards=2, count_kernel=True)
+        scores, labels = _stream(80, seed=1)
+        idx.insert_batch(scores, labels)
+        assert idx.metrics.snapshot()[
+            "count_kernel_calls_total"]["value"] == 0
+        idx.close()
+
+    def test_env_interpret_force_enables(self, monkeypatch):
+        """env=interpret turns the kernel on even with the config flag
+        off — how the existing suites run kernel-on wholesale."""
+        monkeypatch.setenv("TUPLEWISE_SERVING_PALLAS", "interpret")
+        idx = ExactAucIndex(engine="jax", shards=2, compact_every=32)
+        monkeypatch.delenv("TUPLEWISE_SERVING_PALLAS")
+        xla = ExactAucIndex(engine="jax", shards=2, compact_every=32)
+        scores, labels = _stream(120, seed=2)
+        for i in range(0, 120, 40):
+            idx.insert_batch(scores[i:i + 40], labels[i:i + 40])
+            xla.insert_batch(scores[i:i + 40], labels[i:i + 40])
+        assert idx._wins2 == xla._wins2
+        assert idx.metrics.snapshot()[
+            "count_kernel_calls_total"]["value"] > 0
+        assert xla.metrics.snapshot()[
+            "count_kernel_calls_total"]["value"] == 0
+        idx.close()
+        xla.close()
+
+
+class TestFallback:
+    def test_forced_failure_falls_back_bit_identical(self):
+        """A Mosaic failure (forced via the test hook) serves the XLA
+        twin in the same call — results bit-identical, geometry
+        latched, fallback counted."""
+        scores, labels = _stream(300, seed=17)
+        xla = ExactAucIndex(engine="jax", compact_every=64, shards=2)
+        ker = ExactAucIndex(engine="jax", compact_every=64, shards=2,
+                            count_kernel=True)
+        pallas_counts.FORCE_FAIL = True
+        for i in range(0, 300, 60):
+            xla.insert_batch(scores[i:i + 60], labels[i:i + 60])
+            ker.insert_batch(scores[i:i + 60], labels[i:i + 60])
+            assert xla._wins2 == ker._wins2, i
+        snap = ker.metrics.snapshot()
+        assert snap["count_kernel_fallbacks_total"]["value"] > 0
+        assert snap["count_kernel_calls_total"]["value"] == 0
+        assert len(sc._KERNEL_BROKEN) > 0
+        # latched: clearing the hook does NOT resurrect the broken
+        # geometry — no per-request retry of a failed lowering
+        pallas_counts.FORCE_FAIL = False
+        fb = snap["count_kernel_fallbacks_total"]["value"]
+        ker.insert_batch(scores[:60], labels[:60])
+        xla.insert_batch(scores[:60], labels[:60])
+        assert xla._wins2 == ker._wins2
+        snap2 = ker.metrics.snapshot()
+        assert snap2["count_kernel_fallbacks_total"]["value"] == fb
+        xla.close()
+        ker.close()
+
+    def test_fleet_forced_failure_falls_back(self):
+        pallas_counts.FORCE_FAIL = True
+        fleet = TenantFleetIndex(compact_every=64, count_kernel=True)
+        ref = TenantFleetIndex(compact_every=64)
+        scores, labels = _stream(120, seed=19)
+        for i in range(0, 120, 40):
+            items = [("a", scores[i:i + 20], labels[i:i + 20]),
+                     ("b", scores[i + 20:i + 40], labels[i + 20:i + 40])]
+            fleet.apply_inserts(list(items))
+            ref.apply_inserts(list(items))
+        for t in ("a", "b"):
+            assert fleet.wins2(t) == ref.wins2(t)
+        snap = fleet.metrics.snapshot()
+        assert snap["count_kernel_fallbacks_total"]["value"] > 0
+        fleet.close()
+        ref.close()
+
+
+class TestChaosHealWithKernel:
+    def test_device_loss_heals_bit_identical(self):
+        """A device error mid-count with the kernel ON: probe →
+        reshard over the survivor → re-place (base, delta AND the
+        tombstone mirror) → retry; wins2 stays bit-identical to the
+        unfaulted single-host index. The chaos fault must NOT latch
+        the kernel as broken (the XLA twin fails the same way)."""
+        scores, labels = _stream(700, seed=23)
+        inj = FaultInjector.from_spec({"faults": [
+            {"point": "sharded_count", "on_call": 7, "action": "error",
+             "dropped": [1]}]})
+        hurt = ExactAucIndex(engine="jax", compact_every=48, window=200,
+                             shards=2, chaos=inj, count_kernel=True)
+        plain = ExactAucIndex(engine="jax", compact_every=48,
+                              window=200)
+        for i in range(0, 700, 41):
+            j = min(i + 41, 700)
+            hurt.insert_batch(scores[i:j], labels[i:j])
+            plain.insert_batch(scores[i:j], labels[i:j])
+            assert hurt._wins2 == plain._wins2, i
+        snap = hurt.metrics.snapshot()
+        assert snap["reshard_events"]["value"] >= 1
+        assert hurt.shards == 1           # shrank to the survivor
+        assert snap["count_kernel_calls_total"]["value"] > 0
+        assert not sc._KERNEL_BROKEN      # chaos never latches
+        hurt.close()
+        plain.close()
+
+    def test_fleet_device_loss_heals_bit_identical(self):
+        scores, labels = _stream(400, seed=29)
+        inj = FaultInjector.from_spec({"faults": [
+            {"point": "sharded_count", "on_call": 5, "action": "error",
+             "dropped": [1]}]})
+        hurt = TenantFleetIndex(compact_every=48, shards=2, chaos=inj,
+                                count_kernel=True)
+        ref = TenantFleetIndex(compact_every=48)
+        for i in range(0, 400, 80):
+            items = [("a", scores[i:i + 40], labels[i:i + 40]),
+                     ("b", scores[i + 40:i + 80], labels[i + 40:i + 80])]
+            hurt.apply_inserts(list(items))
+            ref.apply_inserts(list(items))
+        for t in ("a", "b"):
+            assert hurt.wins2(t) == ref.wins2(t)
+        assert hurt.metrics.snapshot()["reshard_events"]["value"] >= 1
+        hurt.close()
+        ref.close()
+
+
+class TestFleetKernel:
+    def test_parity_with_promotion_demotion_and_drop(self):
+        """Whale promotion, demotion and a tenant drop (dirty-row slot
+        reuse) mid-stream, kernel on — per-tenant wins2 bit-identical
+        to dedicated single-tenant indexes throughout."""
+        rng = np.random.default_rng(31)
+        fleet = TenantFleetIndex(window=150, compact_every=24,
+                                 shards=2, whale_threshold=100,
+                                 count_kernel=True)
+        singles = {}
+
+        def push(tid, k):
+            labels = rng.random(k) < 0.5
+            scores = (rng.standard_normal(k) + 0.8 * labels).astype(
+                np.float32)
+            if tid not in singles:
+                singles[tid] = ExactAucIndex(window=150,
+                                             compact_every=24,
+                                             engine="jax")
+            singles[tid].insert_batch(scores, labels)
+            return (tid, scores, labels)
+
+        for step in range(16):
+            items = [push("whale", 30)]
+            items += [push(f"s{k}", 6) for k in range(4)]
+            fleet.apply_inserts(items)
+            if step == 8:
+                fleet.drop("s0")
+                singles.pop("s0").close()
+            for tid, idx in singles.items():
+                assert fleet.wins2(tid) == idx._wins2, (step, tid)
+        assert fleet.is_whale("whale")
+        fleet.demote("whale")
+        items = [push("whale", 10)]
+        fleet.apply_inserts(items)
+        assert fleet.wins2("whale") == singles["whale"]._wins2
+        snap = fleet.metrics.snapshot()
+        assert snap["count_kernel_calls_total"]["value"] > 0
+        assert snap["count_kernel_fallbacks_total"]["value"] == 0
+        assert snap["fleet_whale_promotions"]["value"] >= 1
+        fleet.close()
+        for s in singles.values():
+            s.close()
+
+    @pytest.mark.parametrize("T", [1, 32, 256])
+    def test_parity_across_fleet_sizes(self, T):
+        """T=1/32/256 packs, kernel vs XLA fleet — wins2 bit-identical
+        per tenant (the XLA fleet is itself pinned to independent
+        single-tenant indexes elsewhere)."""
+        rng = np.random.default_rng(59 + T)
+        xla = TenantFleetIndex(compact_every=64, shards=2)
+        ker = TenantFleetIndex(compact_every=64, shards=2,
+                               count_kernel=True)
+        for _ in range(3):
+            items = []
+            for t in range(T):
+                k = 3
+                labels = rng.random(k) < 0.5
+                s = (rng.standard_normal(k) + 0.8 * labels).astype(
+                    np.float32)
+                items.append((f"t{t}", s, labels))
+            xla.apply_inserts(list(items))
+            ker.apply_inserts(list(items))
+        assert ({t: xla.wins2(t) for t in xla.tenants()}
+                == {t: ker.wins2(t) for t in ker.tenants()})
+        snap = ker.metrics.snapshot()
+        assert snap["count_kernel_calls_total"]["value"] > 0
+        assert snap["count_kernel_fallbacks_total"]["value"] == 0
+        xla.close()
+        ker.close()
+
+    def test_one_kernel_call_per_fleet_batch(self):
+        fleet = TenantFleetIndex(compact_every=1000, count_kernel=True)
+        scores, labels = _stream(200, seed=37)
+        applies = 0
+        for i in range(0, 200, 50):
+            fleet.apply_inserts(
+                [("a", scores[i:i + 25], labels[i:i + 25]),
+                 ("b", scores[i + 25:i + 50], labels[i + 25:i + 50])])
+            applies += 1
+        snap = fleet.metrics.snapshot()
+        assert snap["count_kernel_calls_total"]["value"] == applies
+        assert snap["fleet_count_calls_total"]["value"] == applies
+        fleet.close()
+
+
+class TestCompileCacheLadder:
+    def test_fleet_cache_invariant_to_live_tenant_count(self):
+        """Compile-cache growth tracks the (T_bucket, cap, q_bucket)
+        ladder, never the live tenant count: tenants 2 → 8 stay inside
+        the T_bucket=8 floor (no new kernel entries); crossing to 9
+        grows the ladder by exactly the new T_bucket geometry."""
+        fleet = TenantFleetIndex(compact_every=10_000,
+                                 count_kernel=True)
+        rng = np.random.default_rng(41)
+
+        def push(n_tenants):
+            items = []
+            for t in range(n_tenants):
+                labels = rng.random(4) < 0.5
+                s = rng.standard_normal(4).astype(np.float32)
+                items.append((f"t{t}", s, labels))
+            fleet.apply_inserts(items)
+
+        push(2)
+        baseline = pallas_counts.kernel_cache_sizes()["tenant_local"]
+        for n in (3, 5, 8):
+            push(n)
+        assert pallas_counts.kernel_cache_sizes()[
+            "tenant_local"] == baseline, "cache grew inside one bucket"
+        push(9)    # crosses T_bucket 8 -> 16
+        grown = pallas_counts.kernel_cache_sizes()["tenant_local"]
+        assert grown == baseline + 1
+        fleet.close()
+
+    def test_flat_cache_keyed_on_buckets_only(self):
+        """Two streams of different lengths inside the same bucket
+        ladder share every flat-kernel compile."""
+        scores, labels = _stream(140, seed=43)
+        a = ExactAucIndex(engine="jax", compact_every=32, shards=2,
+                          count_kernel=True)
+        for i in range(0, 140, 35):
+            a.insert_batch(scores[i:i + 35], labels[i:i + 35])
+        size_a = pallas_counts.kernel_cache_sizes()["flat_sharded"]
+        b = ExactAucIndex(engine="jax", compact_every=32, shards=2,
+                          count_kernel=True)
+        for i in range(0, 105, 35):
+            b.insert_batch(scores[i:i + 35], labels[i:i + 35])
+        assert pallas_counts.kernel_cache_sizes()[
+            "flat_sharded"] == size_a
+        a.close()
+        b.close()
+
+
+class TestKernelRecovery:
+    def test_fleet_snapshot_roundtrip_with_kernel(self, tmp_path):
+        """Snapshot/restore with count_kernel on — per-tenant wins2
+        and streaming estimates bit-identical across the restart."""
+        from tuplewise_tpu.serving import MultiTenantEngine, ServingConfig
+
+        cfg = ServingConfig(window=100, compact_every=32,
+                            snapshot_dir=str(tmp_path / "d"),
+                            snapshot_every=90, count_kernel=True)
+        rng = np.random.default_rng(47)
+        with MultiTenantEngine(cfg) as eng:
+            for i in range(120):
+                eng.insert(f"u{i % 3}", rng.standard_normal(2),
+                           rng.random(2) < 0.5).result(10.0)
+            eng.flush()
+            ref = {t: eng.fleet.wins2(t) for t in eng.fleet.tenants()}
+        with MultiTenantEngine(cfg, recover=True) as eng2:
+            got = {t: eng2.fleet.wins2(t)
+                   for t in eng2.fleet.tenants()}
+            assert eng2.fleet._ck
+        assert ref == got
+
+    def test_sigkill_recover_with_kernel(self, tmp_path):
+        """SIGKILL a --count-kernel serve mid-stream, --recover,
+        finish — final AUC bit-identical to an uninterrupted
+        kernel-off index (one contract covers both engines)."""
+        d = str(tmp_path / "rk")
+        rng = np.random.default_rng(53)
+        events = [(float(rng.standard_normal() + 0.8 * (i % 3 == 0)),
+                   int(i % 3 == 0)) for i in range(200)]
+        lines = [json.dumps({"op": "insert", "score": s, "label": b})
+                 for s, b in events]
+        args = [sys.executable, "-m", "tuplewise_tpu.harness.cli",
+                "serve", "--policy", "block", "--count-kernel",
+                "--mesh-shards", "2", "--snapshot-dir", d,
+                "--snapshot-every", "60", "--compact-every", "32"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        p1 = subprocess.Popen(args, stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE, text=True,
+                              env=env, cwd=repo)
+        for ln in lines[:120]:
+            p1.stdin.write(ln + "\n")
+        p1.stdin.flush()
+        for _ in range(120):
+            assert json.loads(p1.stdout.readline())["ok"]
+        os.kill(p1.pid, signal.SIGKILL)
+        p1.wait(timeout=30)
+
+        feed = lines[120:] + [json.dumps({"op": "query"})]
+        p2 = subprocess.Popen(args + ["--recover"],
+                              stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE, text=True,
+                              env=env, cwd=repo)
+        out, _ = p2.communicate("\n".join(feed) + "\n", timeout=240)
+        resp = [json.loads(ln) for ln in out.strip().splitlines()]
+        assert all(r["ok"] for r in resp)
+        got = [r for r in resp if "auc_exact" in r][-1]["auc_exact"]
+
+        ref = ExactAucIndex(engine="jax", compact_every=32)
+        for s, b in events:
+            ref.insert_batch([s], [b])
+        assert got == ref.auc()
+        ref.close()

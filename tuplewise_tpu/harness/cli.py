@@ -423,6 +423,17 @@ def main(argv=None) -> int:
                             "this many minor compactions merged into "
                             "it, regardless of its size (safety bound;"
                             " --delta-fraction normally rules)")
+        p.add_argument("--count-kernel", action="store_true",
+                       help="run the count hot loop (searchsorted rank"
+                            " of base + delta runs − tombstone "
+                            "multiset) as ONE Pallas kernel invocation"
+                            " per device per micro-batch [ISSUE 10]; "
+                            "bit-identical integer counts, automatic "
+                            "XLA fallback on kernel failure. On CPU "
+                            "the kernel runs in interpret mode "
+                            "(parity, not speed); "
+                            "TUPLEWISE_SERVING_PALLAS=interpret|off "
+                            "overrides")
         p.add_argument("--max-batch", type=int, default=256)
         p.add_argument("--flush-timeout-ms", type=float, default=2.0)
         p.add_argument("--queue-size", type=int, default=1024)
@@ -600,6 +611,7 @@ def main(argv=None) -> int:
             bg_compact=args.bg_compact,
             delta_fraction=args.delta_fraction,
             max_delta_runs=args.max_delta_runs,
+            count_kernel=args.count_kernel,
             max_batch=args.max_batch,
             flush_timeout_s=args.flush_timeout_ms / 1e3,
             queue_size=args.queue_size, policy=args.policy,

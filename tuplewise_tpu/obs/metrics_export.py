@@ -41,6 +41,16 @@ import time
 from typing import Optional
 
 
+# Config fields added AFTER the digest began stamping perf-history
+# rows, mapped to their defaults. A field at its default is dropped
+# from the digest blob, so rows recorded before the field existed keep
+# joining runs that don't use it — an additive config evolution must
+# not orphan the perf gate's committed history [ISSUE 10 satellite].
+# A NON-default value still lands in the blob (different config =>
+# different digest, as it should).
+_ADDITIVE_DEFAULTS = {"count_kernel": False}
+
+
 def config_digest(config) -> str:
     """Short stable digest of a config mapping/dataclass — the join key
     that keeps metrics rows from different configs apart."""
@@ -48,6 +58,10 @@ def config_digest(config) -> str:
 
     if dataclasses.is_dataclass(config) and not isinstance(config, type):
         config = dataclasses.asdict(config)
+    if isinstance(config, dict):
+        config = {k: v for k, v in config.items()
+                  if not (k in _ADDITIVE_DEFAULTS
+                          and v == _ADDITIVE_DEFAULTS[k])}
     blob = json.dumps(config, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
 

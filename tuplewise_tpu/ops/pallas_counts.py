@@ -1,0 +1,330 @@
+"""Pallas TPU kernel for the serving count hot loop [ISSUE 10].
+
+The serving index's per-micro-batch device work is integer rank
+counting: for each query q, ``less = #{v in R : v < q}`` and
+``leq = #{v in R : v <= q}`` where R is a SIGNED union of sorted runs —
+base + consolidated delta runs (+1) minus the tombstone multiset (−1).
+The XLA path dispatches a ``searchsorted`` pair per run and folds the
+tombstone on the host; this kernel fuses the whole thing into ONE
+Pallas invocation per device: every run streams through VMEM once, the
+signed combination accumulates in-kernel into one small integer block,
+and only that block crosses back for the psum.
+
+**Rank by comparison counting.** A binary search is the wrong shape for
+the TPU vector unit (log-depth data-dependent gathers); the VPU-native
+lowering of searchsorted is the comparison count
+
+    less[q] = sum_i 1{run_i < q},    leq[q] = sum_i 1{run_i <= q}
+
+computed as a [run-tile, query-tile] broadcast compare + sublane
+reduction — the pair-grid pattern ``ops.pallas_pairs`` already runs at
+~7e11 cells/s/chip, with integer accumulation instead of Kahan floats.
+Equality with ``searchsorted`` is exact (integers; counting does not
+even need sortedness), so kernel-vs-XLA parity is bit-exact by
+construction. +inf padding contributes 0 to both counts for finite
+queries, exactly as in the padded searchsorted path. The O(cap) work
+per query tile (vs O(log cap)) is the standard trade: the runs stream
+through VMEM once per micro-batch at full VPU width, with no
+data-dependent addressing for Mosaic to choke on.
+
+Two variants share the layout [ISSUE 10 tentpole]:
+
+* **flat-run** (``flat_signed_count_fn`` / ``sharded_signed_count_fn``)
+  — the single-tenant index: k runs with per-run sign and query-set
+  assignment, TWO query sets in one invocation (insert queries vs the
+  neg side's runs AND vs the pos side's runs ride one dispatch), one
+  ``[4, q_bucket]`` int32 result (less/leq per query set).
+  Runs enter as [cap, 1] sublane columns, queries as [1, qb] lane rows
+  — the ``pallas_pairs`` orientation.
+* **tenant-axis** (``tenant_signed_count_fn`` /
+  ``tenant_signed_count_local_fn``) — the fleet packs: ``[S, T_bucket,
+  cap]`` per class, per-tenant query blocks, one ``[4, q_bucket,
+  T_bucket]`` result. Queries enter TRANSPOSED (``[qb, T]``, query axis
+  on sublanes) so the per-tenant outer compare needs no in-kernel
+  transpose — pack rows stay on lanes, query columns on sublanes.
+
+Compile shapes follow the existing ``(T_bucket, cap, q_bucket)``
+power-of-two bucket ladders in every argument, so the compile cache is
+invariant to live tenant count and run occupancy. CPU execution uses
+interpret mode (``pallas_guide``: interpret=True), which is how CI and
+the parity suites run it; dispatch-mode resolution (config opt-in +
+``TUPLEWISE_SERVING_PALLAS`` override) lives in ``ops.pallas_modes``.
+
+The dispatchers with XLA fallback live in
+``parallel.sharded_counts`` (``signed_pair_counts`` /
+``tenant_pack_counts``); this module holds only the kernel builders.
+"""
+
+from __future__ import annotations
+
+import functools
+
+# run-axis and query-axis tile caps: a [tile_r, tile_q] int32 compare
+# block tops out at 1024*1024*4 B = 4 MiB live VMEM — comfortable under
+# double buffering, and every bucket-ladder cap (powers of two >= 256)
+# is a multiple of the clamped tile
+_TILE_R = 1024
+_TILE_Q = 1024
+
+# test hook [ISSUE 10 satellite]: the dispatchers in
+# parallel.sharded_counts raise before touching the kernel when set,
+# exercising the automatic XLA fallback exactly as a Mosaic lowering
+# failure would
+FORCE_FAIL = False
+
+
+def _run_tiles(caps):
+    """Per-run (tile, n_tiles): tile = min(cap, _TILE_R) divides cap
+    because both are powers of two >= 256."""
+    tiles = []
+    for c in caps:
+        t = min(c, _TILE_R)
+        tiles.append((t, c // t))
+    return tiles
+
+
+# --------------------------------------------------------------------- #
+# flat-run variant (single-tenant index)                                 #
+# --------------------------------------------------------------------- #
+
+def _flat_kernel(*refs, k, signs, assign, tiles, tile_q):
+    """One grid step: accumulate each run's signed (less, leq) lane
+    counts for this query tile into the resident [4, q_bucket] int32
+    block (rows 0/1 = query set a, rows 2/3 = set b). Runs shorter
+    than the grid park on their last tile under a false ``pl.when``."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    run_refs = refs[:k]
+    qa_ref, qb_ref, out_ref = refs[k], refs[k + 1], refs[k + 2]
+    i, j = pl.program_id(0), pl.program_id(1)
+    sl = pl.ds(j * tile_q, tile_q)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:, sl] = jnp.zeros((4, tile_q), jnp.int32)
+
+    for r in range(k):
+        q_ref = qa_ref if assign[r] == 0 else qb_ref
+        row = 2 * assign[r]
+
+        def _acc(ref=run_refs[r], q_ref=q_ref, row=row, s=signs[r]):
+            col = ref[:, :]                       # [tile_r, 1] sublanes
+            q = q_ref[:, :]                       # [1, tile_q] lanes
+            less = jnp.sum((col < q).astype(jnp.int32),
+                           axis=0, keepdims=True)
+            leq = jnp.sum((col <= q).astype(jnp.int32),
+                          axis=0, keepdims=True)
+            out_ref[row:row + 1, sl] = out_ref[row:row + 1, sl] + s * less
+            out_ref[row + 1:row + 2, sl] = (
+                out_ref[row + 1:row + 2, sl] + s * leq)
+
+        pl.when(i < tiles[r][1])(_acc)
+
+
+def _flat_call(caps, signs, assign, q_bucket, interpret):
+    """Unjitted builder: fn(run_cols_1d, qa_1d, qb_1d) -> [4, qb] i32.
+    Runs are +inf-padded 1-D arrays of length caps[r]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    k = len(caps)
+    tiles = tuple(_run_tiles(caps))
+    tile_q = min(q_bucket, _TILE_Q)
+    gi = max((n for _, n in tiles), default=1)
+    gj = q_bucket // tile_q
+    in_specs = [
+        pl.BlockSpec((t, 1), (lambda i, j, n=n: (jnp.minimum(i, n - 1), 0)))
+        for t, n in tiles
+    ]
+    in_specs += [pl.BlockSpec((1, tile_q), lambda i, j: (0, j))] * 2
+
+    def call(runs, qa, qb):
+        cols = [r.reshape(-1, 1) for r in runs]
+        return pl.pallas_call(
+            functools.partial(_flat_kernel, k=k, signs=signs,
+                              assign=assign, tiles=tiles, tile_q=tile_q),
+            out_shape=jax.ShapeDtypeStruct((4, q_bucket), jnp.int32),
+            grid=(gi, gj),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((4, q_bucket), lambda i, j: (0, 0)),
+            interpret=interpret,
+        )(*cols, qa.reshape(1, -1), qb.reshape(1, -1))
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def flat_signed_count_fn(caps, signs, assign, q_bucket: int,
+                         interpret: bool):
+    """Jitted single-device fused count: ``(runs tuple of [cap_r]
+    padded sorted arrays, qa [qb], qb [qb]) -> [4, qb] int32`` — rows
+    (less_a, leq_a, less_b, leq_b), each run weighted by its sign and
+    counted against its assigned query set. Cache key = the bucket
+    ladder alone."""
+    import jax
+
+    call = _flat_call(caps, signs, assign, q_bucket, interpret)
+    return jax.jit(lambda runs, qa, qb: call(runs, qa, qb))
+
+
+@functools.lru_cache(maxsize=None)
+def sharded_signed_count_fn(mesh, caps, signs, assign, q_bucket: int,
+                            interpret: bool):
+    """Mesh twin of :func:`flat_signed_count_fn`: runs are placed
+    ``[S, cap_r]`` row shards, queries replicated; ONE kernel
+    invocation per device, ONE psum of the [4, qb] integer block —
+    the whole per-micro-batch count in one collective [ISSUE 10]."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    k = len(caps)
+    call = _flat_call(caps, signs, assign, q_bucket, interpret)
+
+    def body(runs, qa, qb):
+        out = call(tuple(r[0] for r in runs), qa, qb)
+        return lax.psum(out, axes)
+
+    @jax.jit
+    def f(runs, qa, qb):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=((P(axes),) * k, P(), P()), out_specs=P(),
+            check_vma=False,
+        )(runs, qa, qb)
+
+    return f
+
+
+# --------------------------------------------------------------------- #
+# tenant-axis variant (fleet packs)                                      #
+# --------------------------------------------------------------------- #
+
+def _tenant_kernel(neg_ref, pos_ref, qn_ref, qp_ref, out_ref, *,
+                   tiles_n, tiles_p, tile_q, lead):
+    """One (tenant, query-tile, run-tile) grid step: tenant t's pack
+    rows (lanes) against its transposed query column (sublanes), both
+    class sides in the same pass. ``lead`` marks the mesh layout's
+    leading device axis on the pack blocks."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:, :, :] = jnp.zeros_like(out_ref)
+
+    for ref, q_ref, row, tiles in ((neg_ref, qn_ref, 0, tiles_n),
+                                   (pos_ref, qp_ref, 2, tiles_p)):
+        def _acc(ref=ref, q_ref=q_ref, row=row):
+            vals = ref[0, 0, :] if lead else ref[0, :]   # [tile_c] lanes
+            q = q_ref[:, :]                              # [tile_q, 1]
+            less = jnp.sum((vals[None, :] < q).astype(jnp.int32),
+                           axis=1, keepdims=True)        # [tile_q, 1]
+            leq = jnp.sum((vals[None, :] <= q).astype(jnp.int32),
+                          axis=1, keepdims=True)
+            out_ref[row:row + 1, :, :] = (
+                out_ref[row:row + 1, :, :] + less[None])
+            out_ref[row + 1:row + 2, :, :] = (
+                out_ref[row + 1:row + 2, :, :] + leq[None])
+
+        pl.when(c < tiles[1])(_acc)
+
+
+def _tenant_call(t_bucket, cap_pos, cap_neg, q_bucket, interpret, lead):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    (tn, gn), = _run_tiles((cap_neg,))
+    (tp, gp), = _run_tiles((cap_pos,))
+    tile_q = min(q_bucket, _TILE_Q)
+    gj = q_bucket // tile_q
+    gc = max(gn, gp)
+
+    def pack_spec(tile_c, n):
+        if lead:
+            return pl.BlockSpec(
+                (1, 1, tile_c),
+                lambda t, j, c, n=n: (0, t, jnp.minimum(c, n - 1)))
+        return pl.BlockSpec(
+            (1, tile_c), lambda t, j, c, n=n: (t, jnp.minimum(c, n - 1)))
+
+    def call(pos, neg, qn_t, qp_t):
+        return pl.pallas_call(
+            functools.partial(_tenant_kernel, tiles_n=(tn, gn),
+                              tiles_p=(tp, gp), tile_q=tile_q,
+                              lead=lead),
+            out_shape=jax.ShapeDtypeStruct(
+                (4, q_bucket, t_bucket), jnp.int32),
+            grid=(t_bucket, gj, gc),
+            in_specs=[
+                pack_spec(tn, gn),
+                pack_spec(tp, gp),
+                pl.BlockSpec((tile_q, 1), lambda t, j, c: (j, t)),
+                pl.BlockSpec((tile_q, 1), lambda t, j, c: (j, t)),
+            ],
+            out_specs=pl.BlockSpec((4, tile_q, 1),
+                                   lambda t, j, c: (0, j, t)),
+            interpret=interpret,
+        )(neg, pos, qn_t, qp_t)
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def tenant_signed_count_local_fn(t_bucket: int, cap_pos: int,
+                                 cap_neg: int, q_bucket: int,
+                                 interpret: bool):
+    """Jitted single-device fleet count kernel: ``(pos_pack [T, cap_p],
+    neg_pack [T, cap_n], qn_t [qb, T], qp_t [qb, T]) -> [4, qb, T]``
+    int32 — rows (less_n, leq_n, less_p, leq_p), one invocation for
+    the whole coalesced multi-tenant micro-batch."""
+    import jax
+
+    call = _tenant_call(t_bucket, cap_pos, cap_neg, q_bucket,
+                        interpret, lead=False)
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=None)
+def tenant_signed_count_fn(mesh, t_bucket: int, cap_pos: int,
+                           cap_neg: int, q_bucket: int,
+                           interpret: bool):
+    """Mesh twin: packs are placed ``[S, T, cap]`` shards; ONE kernel
+    invocation per device + ONE psum of the [4, qb, T] integer block."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    call = _tenant_call(t_bucket, cap_pos, cap_neg, q_bucket,
+                        interpret, lead=True)
+
+    def body(pos, neg, qn_t, qp_t):
+        return lax.psum(call(pos, neg, qn_t, qp_t), axes)
+
+    @jax.jit
+    def f(pos, neg, qn_t, qp_t):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axes), P(axes), P(), P()),
+            out_specs=P(), check_vma=False,
+        )(pos, neg, qn_t, qp_t)
+
+    return f
+
+
+def kernel_cache_sizes() -> dict:
+    """Live compile-cache entry counts per kernel family — what the
+    bucket-ladder boundedness tests pin [ISSUE 10 satellite]."""
+    return {
+        "flat": flat_signed_count_fn.cache_info().currsize,
+        "flat_sharded": sharded_signed_count_fn.cache_info().currsize,
+        "tenant_local": tenant_signed_count_local_fn.cache_info().currsize,
+        "tenant_sharded": tenant_signed_count_fn.cache_info().currsize,
+    }

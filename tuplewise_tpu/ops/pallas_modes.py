@@ -1,0 +1,58 @@
+"""Pallas dispatch-mode resolution — the ONE copy of the env-override
+semantics [ISSUE 10 satellite].
+
+Two subsystems run Pallas kernels behind an opt-in/auto gate:
+
+* the **harness hot loops** (``harness.variance`` / ``harness.mesh_mc``
+  / ``ops.pair_tiles``) — auto-on on TPU, forced through interpret mode
+  on CPU for parity tests via ``TUPLEWISE_HARNESS_PALLAS``;
+* the **serving count kernel** (``ops.pallas_counts`` behind
+  ``ServingConfig.count_kernel``) — opt-in per config, overridable via
+  ``TUPLEWISE_SERVING_PALLAS``.
+
+Both overrides share one value grammar (``interpret`` | ``off`` |
+unset/``auto``) and one resolution rule, implemented here exactly once.
+``resolve_pallas_mode`` used to live in ``ops.pallas_pairs`` (which
+re-exports it for its existing harness call sites); the serving twin
+layers the explicit opt-in on top of the same resolver instead of
+growing a second copy of the env semantics.
+"""
+
+from __future__ import annotations
+
+import os
+
+HARNESS_ENV = "TUPLEWISE_HARNESS_PALLAS"
+SERVING_ENV = "TUPLEWISE_SERVING_PALLAS"
+
+
+def resolve_pallas_mode(platform: str, env: str = HARNESS_ENV):
+    """(use_pallas, interpret) for a hot loop executing on ``platform``,
+    honoring ``env`` = ``interpret`` | ``off`` | unset (auto): interpret
+    forces the kernel through the Pallas interpreter (CPU parity runs),
+    off disables it everywhere, auto uses it exactly on TPU."""
+    mode = os.environ.get(env, "auto")
+    interpret = mode == "interpret"
+    return interpret or (mode != "off" and platform == "tpu"), interpret
+
+
+def resolve_serving_counts_mode(platform: str, enabled: bool):
+    """(use_kernel, interpret) for the serving count kernel [ISSUE 10].
+
+    The kernel is opt-in (``enabled`` = ``ServingConfig.count_kernel``,
+    default off) and ``TUPLEWISE_SERVING_PALLAS`` overrides through the
+    same grammar as the harness env: ``off`` wins over the config flag
+    (kill switch), ``interpret`` force-enables in interpret mode even
+    off-TPU (how the existing parity/chaos/recovery suites run with the
+    kernel on), and unset/auto honors the config flag — executing
+    natively on TPU, through the interpreter anywhere else (counts are
+    integers, so interpreted results are bit-identical, just slow).
+    """
+    mode = os.environ.get(SERVING_ENV, "auto")
+    if mode == "off":
+        return False, False
+    if mode == "interpret":
+        return True, True
+    if not enabled:
+        return False, False
+    return True, platform != "tpu"

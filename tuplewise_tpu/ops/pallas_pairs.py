@@ -51,16 +51,11 @@ from tuplewise_tpu.ops.kernels import Kernel
 MAX_ROW_BLOCKS = 896
 
 
-def resolve_pallas_mode(platform: str):
-    """(use_pallas, interpret) for a harness hot loop executing on
-    ``platform``, honoring TUPLEWISE_HARNESS_PALLAS=interpret|off —
-    the single copy of the override semantics shared by
-    harness.variance and harness.mesh_mc."""
-    import os
-
-    mode = os.environ.get("TUPLEWISE_HARNESS_PALLAS", "auto")
-    interpret = mode == "interpret"
-    return interpret or (mode != "off" and platform == "tpu"), interpret
+# the TUPLEWISE_HARNESS_PALLAS=interpret|off override semantics moved
+# to ops.pallas_modes (ONE copy shared with the serving count kernel's
+# TUPLEWISE_SERVING_PALLAS twin [ISSUE 10 satellite]); re-exported here
+# for the existing harness call sites.
+from tuplewise_tpu.ops.pallas_modes import resolve_pallas_mode  # noqa: F401
 
 
 def preferred_pair_tiles(kernel: Kernel, m1: int, m2: int):

@@ -332,6 +332,154 @@ def sharded_counts(mesh, base_dev, cap: int, q: np.ndarray,
 
 
 # --------------------------------------------------------------------- #
+# Pallas-fused signed counts [ISSUE 10]                                  #
+# --------------------------------------------------------------------- #
+
+# geometries whose Pallas lowering failed once: the request path falls
+# back to the XLA twin and never retries the broken shape per call
+_KERNEL_BROKEN: set = set()
+
+
+def _pad_run(arr: np.ndarray, cap: int, dtype) -> np.ndarray:
+    out = np.full(cap, np.inf, dtype=dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _xla_signed_pair_fn(mesh, caps: Tuple[int, ...],
+                        signs: Tuple[int, ...],
+                        assign: Tuple[int, ...], q_bucket: int):
+    """XLA twin of the fused kernel — the automatic fallback target
+    [ISSUE 10]: per-run searchsorted pairs, signed accumulation into
+    the same [4, q_bucket] int32 block, ONE psum (mesh) or none
+    (mesh=None). Bit-identical to the kernel by integer exactness."""
+    import jax
+    import jax.numpy as jnp
+
+    k = len(caps)
+
+    def accum(rows, qa, qb):
+        out = jnp.zeros((4, q_bucket), dtype=jnp.int32)
+        for r in range(k):
+            q = qa if assign[r] == 0 else qb
+            row = 2 * assign[r]
+            less = jnp.searchsorted(rows[r], q, side="left")
+            leq = jnp.searchsorted(rows[r], q, side="right")
+            out = out.at[row].add(signs[r] * less.astype(jnp.int32))
+            out = out.at[row + 1].add(signs[r] * leq.astype(jnp.int32))
+        return out
+
+    if mesh is None:
+        return jax.jit(lambda runs, qa, qb: accum(runs, qa, qb))
+
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+
+    def body(runs, qa, qb):
+        return lax.psum(accum(tuple(r[0] for r in runs), qa, qb), axes)
+
+    @jax.jit
+    def f(runs, qa, qb):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=((P(axes),) * k, P(), P()), out_specs=P(),
+            check_vma=False,
+        )(runs, qa, qb)
+
+    return f
+
+
+def _count_kernel_metrics(metrics, fallback: bool) -> None:
+    if metrics is None:
+        return
+    name = ("count_kernel_fallbacks_total" if fallback
+            else "count_kernel_calls_total")
+    metrics.counter(name).inc()
+
+
+def signed_pair_counts(mesh, runs_a, runs_b, q_a: np.ndarray,
+                       q_b: np.ndarray, dtype, *, kernel=None,
+                       chaos=None, metrics=None):
+    """Fused signed counts of two query sets — the serving count hot
+    loop in ONE device dispatch [ISSUE 10].
+
+    ``runs_a`` / ``runs_b``: sequences of ``(run, cap, sign)`` counted
+    against ``q_a`` / ``q_b`` respectively (sign +1 for base/delta
+    runs, −1 for the tombstone multiset — additivity over signed
+    multisets). With a mesh each run is a placed ``[S, cap]`` device
+    array; with ``mesh=None`` each is the host sorted array, padded
+    here to its bucket. Returns four int64 arrays ``(less_a, leq_a,
+    less_b, leq_b)`` trimmed to the query lengths.
+
+    ``kernel``: None = XLA searchsorted path (one jitted signed
+    dispatch); else the bool is the Pallas interpret flag and the
+    counts run through ONE ``ops.pallas_counts`` invocation per
+    device. Any kernel failure falls back to the XLA twin in the same
+    call — bit-identical integers — and latches the geometry so a
+    broken Mosaic lowering is never retried per request. A failure
+    that ALSO breaks the XLA twin (a dead mesh device) propagates to
+    the caller's heal loop without latching. ``chaos`` fires the
+    ``sharded_count`` hook, exactly like :func:`sharded_counts`.
+    """
+    if chaos is not None:
+        chaos.fire("sharded_count")
+    la, lb = len(q_a), len(q_b)
+    if not runs_a and not runs_b:
+        return (np.zeros(la, np.int64), np.zeros(la, np.int64),
+                np.zeros(lb, np.int64), np.zeros(lb, np.int64))
+    qb_bucket = next_bucket(max(la, lb, 1))
+    qa_p = np.zeros(qb_bucket, dtype=dtype)
+    qa_p[:la] = q_a
+    qb_p = np.zeros(qb_bucket, dtype=dtype)
+    qb_p[:lb] = q_b
+    devs, caps, signs, assign = [], [], [], []
+    for side, rs in ((0, runs_a), (1, runs_b)):
+        for dev, cap, sign in rs:
+            if mesh is None:
+                dev = _pad_run(np.asarray(dev, dtype=dtype), cap, dtype)
+            devs.append(dev)
+            caps.append(cap)
+            signs.append(sign)
+            assign.append(side)
+    key = (mesh, tuple(caps), tuple(signs), tuple(assign), qb_bucket)
+
+    def _xla():
+        f = _xla_signed_pair_fn(mesh, key[1], key[2], key[3], qb_bucket)
+        return np.asarray(f(tuple(devs), qa_p, qb_p))
+
+    if kernel is not None and key not in _KERNEL_BROKEN:
+        try:
+            from tuplewise_tpu.ops import pallas_counts
+
+            if pallas_counts.FORCE_FAIL:
+                raise RuntimeError("forced kernel failure (test hook)")
+            if mesh is None:
+                f = pallas_counts.flat_signed_count_fn(
+                    key[1], key[2], key[3], qb_bucket, bool(kernel))
+            else:
+                f = pallas_counts.sharded_signed_count_fn(
+                    mesh, key[1], key[2], key[3], qb_bucket,
+                    bool(kernel))
+            out = np.asarray(f(tuple(devs), qa_p, qb_p))
+            _count_kernel_metrics(metrics, fallback=False)
+        except Exception:
+            # the XLA twin decides whether the KERNEL was the problem:
+            # if it also fails (dead device), propagate to the healer
+            # without latching; if it succeeds, the lowering is broken
+            # for this geometry — latch and serve the XLA result
+            out = _xla()
+            _KERNEL_BROKEN.add(key)
+            _count_kernel_metrics(metrics, fallback=True)
+    else:
+        out = _xla()
+    out = out.astype(np.int64)
+    return (out[0, :la], out[1, :la], out[2, :lb], out[3, :lb])
+
+
+# --------------------------------------------------------------------- #
 # on-mesh major merge [ISSUE 5]                                         #
 # --------------------------------------------------------------------- #
 
@@ -856,20 +1004,56 @@ def tenant_count_local_fn(t_bucket: int, cap_pos: int, cap_neg: int,
 def tenant_pack_counts(mesh, pos_pack, cap_pos: int, neg_pack,
                        cap_neg: int, t_bucket: int,
                        q_vs_neg: np.ndarray, q_vs_pos: np.ndarray,
-                       dtype, chaos=None):
+                       dtype, chaos=None, kernel=None, metrics=None):
     """Dispatch one fleet count: padded ``[t_bucket, qb]`` query blocks
     against both class packs. Returns four ``[t_bucket, qb]`` int64
     arrays ``(less_n, leq_n, less_p, leq_p)``. ``chaos`` fires the
     ``sharded_count`` hook — the same point a dead mesh device
     surfaces at, so fleet healing is driven by the same specs as the
     single-tenant index [ISSUE 8].
+
+    ``kernel``: None = the XLA vmapped-searchsorted path; else the
+    bool is the Pallas interpret flag and the whole fleet batch runs
+    through ONE ``ops.pallas_counts`` tenant-axis invocation per
+    device (queries enter transposed so the per-tenant outer compare
+    needs no in-kernel transpose), with the same
+    fallback-then-latch discipline as :func:`signed_pair_counts`
+    [ISSUE 10].
     """
     if chaos is not None:
         chaos.fire("sharded_count")
     qb = q_vs_neg.shape[1]
-    if mesh is None:
-        fn = tenant_count_local_fn(t_bucket, cap_pos, cap_neg, qb)
-    else:
-        fn = tenant_count_fn(mesh, t_bucket, cap_pos, cap_neg, qb)
-    out = fn(pos_pack, neg_pack, q_vs_neg, q_vs_pos)
-    return tuple(np.asarray(o).astype(np.int64) for o in out)
+    key = ("tenant", mesh, t_bucket, cap_pos, cap_neg, qb)
+
+    def _xla():
+        if mesh is None:
+            fn = tenant_count_local_fn(t_bucket, cap_pos, cap_neg, qb)
+        else:
+            fn = tenant_count_fn(mesh, t_bucket, cap_pos, cap_neg, qb)
+        out = fn(pos_pack, neg_pack, q_vs_neg, q_vs_pos)
+        return tuple(np.asarray(o).astype(np.int64) for o in out)
+
+    if kernel is not None and key not in _KERNEL_BROKEN:
+        try:
+            from tuplewise_tpu.ops import pallas_counts
+
+            if pallas_counts.FORCE_FAIL:
+                raise RuntimeError("forced kernel failure (test hook)")
+            qn_t = np.ascontiguousarray(q_vs_neg.T)
+            qp_t = np.ascontiguousarray(q_vs_pos.T)
+            if mesh is None:
+                fn = pallas_counts.tenant_signed_count_local_fn(
+                    t_bucket, cap_pos, cap_neg, qb, bool(kernel))
+            else:
+                fn = pallas_counts.tenant_signed_count_fn(
+                    mesh, t_bucket, cap_pos, cap_neg, qb, bool(kernel))
+            out = np.asarray(fn(pos_pack, neg_pack, qn_t, qp_t))
+            _count_kernel_metrics(metrics, fallback=False)
+            out = out.astype(np.int64)
+            return (out[0].T, out[1].T, out[2].T, out[3].T)
+        except Exception:
+            res = _xla()    # a dead device fails here too -> heals
+            _KERNEL_BROKEN.add(key)
+            _count_kernel_metrics(metrics, fallback=True)
+            return res
+    return _xla()

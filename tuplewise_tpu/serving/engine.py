@@ -127,6 +127,14 @@ class ServingConfig:
     # they exceed this fraction of the base; 0 = PR 2 host-merge path
     delta_fraction: float = 0.25
     max_delta_runs: int = 64       # fold after this many minors merged
+    # Pallas-fused serving counts [ISSUE 10]: run the count hot loop
+    # (searchsorted rank of base + delta runs − tombstone multiset) as
+    # ONE ops.pallas_counts invocation per device per micro-batch.
+    # Opt-in (default off); TUPLEWISE_SERVING_PALLAS=interpret|off
+    # overrides; automatic fallback to the XLA path on unsupported
+    # geometry or Mosaic failure. Integer counts, so kernel-vs-XLA
+    # results are bit-identical.
+    count_kernel: bool = False
     max_batch: int = 256           # micro-batch size cap
     flush_timeout_s: float = 0.002  # batcher drain window
     queue_size: int = 1024         # bounded request queue
@@ -244,6 +252,7 @@ class MicroBatchEngine:
             bg_compact=config.bg_compact, metrics=self.metrics,
             chaos=chaos, delta_fraction=config.delta_fraction,
             max_delta_runs=config.max_delta_runs,
+            count_kernel=config.count_kernel,
             tracer=tracer, flight=self.flight,
         ) if config.kernel == "auc" else None
         # statistical health [ISSUE 7]: the CI-width monitor is fed by

@@ -233,6 +233,13 @@ class _ClassSide:
         self.base_dev = None     # [S, cap] device shards (sharded mode)
         self.cap = 0
         self.placed_base = None  # host array base_dev mirrors
+        # tombstone-run device placement [ISSUE 10]: the count kernel
+        # subtracts the tombstone multiset IN-KERNEL (sign −1), so
+        # kernel mode mirrors tomb_run on-mesh like the delta run;
+        # XLA mode keeps the host-side subtraction and never places it
+        self.tomb_dev = None
+        self.tomb_cap = 0
+        self.placed_tomb = None  # host array tomb_dev mirrors
         self.building = False
         self.snap_buf = 0
         self.snap_tomb = 0
@@ -288,6 +295,17 @@ class ExactAucIndex:
         minor compactions have been merged into it, regardless of its
         size — bounds the delta run's growth and therefore each
         minor's splice-and-ship cost.
+      count_kernel: [ISSUE 10] opt-in Pallas-fused count hot loop
+        (engine="jax"): base + delta + tombstone counts run as ONE
+        ``ops.pallas_counts`` invocation per device per micro-batch
+        (the signed multiset combination accumulates in-kernel; insert
+        AND window-eviction queries ride the same dispatch), falling
+        back to the XLA searchsorted path automatically on unsupported
+        geometry or Mosaic failure. Counts are integers, so
+        kernel-vs-XLA results are bit-identical.
+        ``TUPLEWISE_SERVING_PALLAS=interpret|off`` overrides
+        (interpret force-enables through the Pallas interpreter —
+        the CPU/CI mode; off is the kill switch).
       metrics: a ``utils.profiling.MetricsRegistry`` to record
         ``compactions_total`` / ``compaction_pause_s`` into (the engine
         passes its own so pauses surface in ``stats()``); None = a
@@ -315,6 +333,7 @@ class ExactAucIndex:
                  probe_timeout_s: float = 5.0,
                  delta_fraction: float = 0.25,
                  max_delta_runs: int = 64,
+                 count_kernel: bool = False,
                  tracer=None, flight=None):
         if engine not in ("jax", "numpy"):
             raise ValueError(f"engine must be 'jax' or 'numpy': {engine!r}")
@@ -344,6 +363,26 @@ class ExactAucIndex:
         # delta compaction needs the mesh (the whole point is cutting
         # host->device bytes); single-host mode keeps the plain path
         self._delta = shards is not None and self.delta_fraction > 0
+        # Pallas-fused counts [ISSUE 10]: resolve the dispatch mode
+        # once (config opt-in + env override via the shared resolver);
+        # the resolve costs a jax import, so skip it entirely when the
+        # kernel can't be on
+        self.count_kernel = bool(count_kernel)
+        self._ck = False          # kernel active for this index
+        self._ck_interp = False   # Pallas interpret flag when active
+        import os as _os
+
+        if engine == "jax" and (count_kernel
+                                or _os.environ.get(
+                                    "TUPLEWISE_SERVING_PALLAS")):
+            import jax
+
+            from tuplewise_tpu.ops.pallas_modes import (
+                resolve_serving_counts_mode,
+            )
+
+            self._ck, self._ck_interp = resolve_serving_counts_mode(
+                jax.default_backend(), count_kernel)
         self.chaos = chaos
         self.shard_retries = shard_retries
         self.retry_backoff_s = retry_backoff_s
@@ -408,6 +447,11 @@ class ExactAucIndex:
         self.metrics.counter("shard_retries_total")
         self.metrics.histogram("recovery_time_s")
         self._c_bg_restarts = self.metrics.counter("bg_compactor_restarts")
+        # fused-count observability [ISSUE 10]: calls = kernel
+        # dispatches (the per-micro-batch witness the bench cell
+        # asserts), fallbacks = geometries served by the XLA twin
+        self.metrics.counter("count_kernel_calls_total")
+        self.metrics.counter("count_kernel_fallbacks_total")
         # the heal-and-retry protocol now lives in parallel.self_heal
         # [ISSUE 4] — one implementation for serving AND the batch
         # path; shrink policy (fixed_width=None): counts are additive
@@ -448,10 +492,15 @@ class ExactAucIndex:
     def _base_counts(self, side: _ClassSide,
                      q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """(less, leq) counts of each query against side.base plus —
-        in delta mode — every placed delta run (one call, one psum)."""
+        in delta mode — every placed delta run (one call, one psum).
+        Kernel mode [ISSUE 10] additionally folds the tombstone
+        multiset in (sign −1) — callers must then skip the host-side
+        tomb_run subtraction (``_host_adjust`` keys on ``self._ck``)."""
         if len(q) == 0:
             z = np.zeros(0, dtype=np.int64)
             return z, z
+        if self._ck:
+            return self._kernel_base_counts(side, q)
         if self.shards is not None:
             if len(side.base) == 0 and len(side.delta_run) == 0:
                 z = np.zeros(len(q), dtype=np.int64)
@@ -531,6 +580,9 @@ class ExactAucIndex:
                 side.placed_base = None   # stale mesh: no row reuse
                 self._place(side)
                 self._replace_deltas(side)
+                side.placed_tomb = None
+                side.tomb_dev, side.tomb_cap = None, 0
+                self._replace_tomb(side)
 
     def _replace_deltas(self, side: _ClassSide) -> None:
         """Rebuild the delta run's device placement (mesh change or
@@ -557,7 +609,18 @@ class ExactAucIndex:
         """(less, eq) of each query against side's CURRENT multiset."""
         q = np.asarray(q, dtype=self.dtype)
         less, leq = self._base_counts(side, q)
-        eq = leq - less
+        return self._host_adjust(side, q, less, leq)
+
+    def _host_adjust(self, side: _ClassSide, q: np.ndarray,
+                     base_less: np.ndarray, base_leq: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """(less, eq) vs the side's CURRENT multiset given precomputed
+        base-run counts: the pending buffer (+) and tombstone (−)
+        lists adjust on the host; the consolidated tombstone multiset
+        subtracts here ONLY when the base counts did not already fold
+        it in (the count kernel carries it with sign −1 [ISSUE 10])."""
+        less = np.asarray(base_less, dtype=np.int64).copy()
+        eq = np.asarray(base_leq, dtype=np.int64) - less
         for vals, sign in ((side.buf, 1), (side.tomb, -1)):
             if not vals:
                 continue
@@ -566,7 +629,7 @@ class ExactAucIndex:
             r2 = np.searchsorted(arr, q, side="right").astype(np.int64)
             less += sign * l2
             eq += sign * (r2 - l2)
-        if len(side.tomb_run):
+        if len(side.tomb_run) and not self._ck:
             # the consolidated tombstone multiset: already sorted, its
             # counts subtract — additivity over signed multisets keeps
             # every prefix exact [ISSUE 5]
@@ -577,6 +640,107 @@ class ExactAucIndex:
             less -= l2
             eq -= r2 - l2
         return less, eq
+
+    # ------------------------------------------------------------------ #
+    # Pallas-fused count path [ISSUE 10]                                 #
+    # ------------------------------------------------------------------ #
+    def _replace_tomb(self, side: _ClassSide) -> None:
+        """(Re)place the tombstone multiset's device mirror — kernel
+        mode only (XLA mode subtracts it on the host). Row-reuse via
+        the place_base prev-trick, like the base run."""
+        if (not self._ck or self.shards is None
+                or len(side.tomb_run) == 0):
+            side.tomb_dev, side.tomb_cap = None, 0
+            side.placed_tomb = None
+            return
+        from tuplewise_tpu.parallel.sharded_counts import place_base
+
+        side.tomb_dev, side.tomb_cap, _ = place_base(
+            self._mesh, side.tomb_run, self.dtype,
+            prev=(side.placed_tomb, side.tomb_dev, side.tomb_cap),
+            metrics=self.metrics)
+        side.placed_tomb = side.tomb_run
+
+    def _kernel_runs(self, side: _ClassSide) -> list:
+        """The side's runs for the fused signed count: base and the
+        consolidated delta run (+1), the tombstone multiset (−1).
+        Sharded mode hands placed device arrays (lazily refreshing the
+        tombstone mirror — restore paths leave it stale); single-host
+        mode hands the host arrays for in-dispatch padding."""
+        from tuplewise_tpu.parallel.sharded_counts import next_bucket
+
+        runs = []
+        if self.shards is None:
+            if len(side.base):
+                runs.append((side.base, next_bucket(len(side.base)), 1))
+            if len(side.tomb_run):
+                runs.append((side.tomb_run,
+                             next_bucket(len(side.tomb_run)), -1))
+            return runs
+        if side.placed_tomb is not side.tomb_run:
+            self._replace_tomb(side)
+        if side.base_dev is not None:
+            runs.append((side.base_dev, side.cap, 1))
+        if side.delta_dev is not None:
+            runs.append((side.delta_dev, side.delta_cap, 1))
+        if side.tomb_dev is not None:
+            runs.append((side.tomb_dev, side.tomb_cap, -1))
+        return runs
+
+    def _kernel_base_counts(
+        self, side: _ClassSide, q: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Single-side fused count (score path / non-fused callers):
+        one kernel invocation covering base + delta + tombstone."""
+        less, leq, _, _ = self._fused_counts(
+            lambda: (self._kernel_runs(side), ()),
+            q, np.zeros(0, dtype=self.dtype))
+        return less, leq
+
+    def _fused_pair_base_counts(self, q_vs_neg: np.ndarray,
+                                q_vs_pos: np.ndarray):
+        """Both sides' base counts in ONE kernel invocation per device
+        — the insert hot path's single dispatch [ISSUE 10]."""
+        return self._fused_counts(
+            lambda: (self._kernel_runs(self._neg),
+                     self._kernel_runs(self._pos)),
+            q_vs_neg, q_vs_pos)
+
+    def _fused_counts(self, runs_fn, q_a: np.ndarray, q_b: np.ndarray):
+        """Dispatch one fused signed count with the shared heal-and-
+        retry protocol (sharded mode) — ``runs_fn`` re-reads the
+        placements inside each attempt so a heal's re-placement is
+        picked up."""
+        from tuplewise_tpu.parallel.sharded_counts import (
+            next_bucket, signed_pair_counts,
+        )
+
+        self._q_buckets.add(next_bucket(max(len(q_a), len(q_b), 1)))
+        kernel = self._ck_interp if self._ck else None
+
+        def attempt():
+            runs_a, runs_b = runs_fn()
+            return signed_pair_counts(
+                self._mesh if self.shards is not None else None,
+                runs_a, runs_b, q_a, q_b, self.dtype, kernel=kernel,
+                chaos=self.chaos, metrics=self.metrics)
+
+        if self._healer is None:
+            return attempt()
+        from tuplewise_tpu.parallel.self_heal import HealExhaustedError
+
+        try:
+            with maybe_span(self.tracer, "index.sharded_count",
+                            n_queries=len(q_a) + len(q_b)):
+                return self._healer.run(attempt,
+                                        retries=self.shard_retries,
+                                        on_heal=self._on_heal)
+        except HealExhaustedError as e:
+            self._c_heal_exhausted.inc()
+            if self.flight is not None:
+                self.flight.record("heal_exhausted", error=repr(e))
+                self.flight.auto_dump()
+            raise
 
     def _cross2(self, p_vals: np.ndarray, n_side: _ClassSide) -> int:
         """sum over p of 2*count_less(p in negs) + count_eq: the wins2
@@ -628,6 +792,12 @@ class ExactAucIndex:
         p_new = scores[labels]
         n_new = scores[~labels]
         with self._cv:
+            if self._ck:
+                # fused kernel path [ISSUE 10]: insert AND eviction
+                # counts in ONE device dispatch
+                self._apply_fused(scores, labels, p_new, n_new)
+                self._maybe_compact()
+                return len(scores)
             # new-vs-old (old sets untouched so far), then new-vs-new
             d = self._cross2(p_new, self._neg)
             d += self._cross2_rev(n_new, self._pos)
@@ -641,6 +811,74 @@ class ExactAucIndex:
                 self._evict(len(self._log) - self.window)
             self._maybe_compact()
         return len(scores)
+
+    def _apply_fused(self, scores: np.ndarray, labels: np.ndarray,
+                     p_new: np.ndarray, n_new: np.ndarray) -> None:
+        """Kernel-path insert + window eviction with ONE fused device
+        count per micro-batch [ISSUE 10]: evictions are planned from
+        (log ++ batch) BEFORE the device call, so the evicted values'
+        base-run counts ride the same kernel invocation as the insert
+        queries — legal because only the host buffer and log mutate
+        during an insert; the placed base/delta/tombstone runs cannot.
+        The host-side adjustments then run at exactly the container
+        states the unfused path uses (pre-insert for the insert term,
+        post-insert for the eviction term — the fleet's ``_fold_plan``
+        ordering), so wins2 is bit-identical by construction."""
+        n_evict = 0
+        p_out: List[float] = []
+        n_out: List[float] = []
+        if self.window is not None:
+            n_evict = max(0, len(self._log) + len(scores) - self.window)
+        if n_evict:
+            import itertools
+
+            pool = itertools.chain(
+                self._log, zip(scores.tolist(), labels.tolist()))
+            for v, is_pos in itertools.islice(pool, n_evict):
+                (p_out if is_pos else n_out).append(v)
+        p_out_arr = np.asarray(p_out, dtype=self.dtype)
+        n_out_arr = np.asarray(n_out, dtype=self.dtype)
+        ln, lqn, lp, lqp = self._fused_pair_base_counts(
+            np.concatenate([p_new, p_out_arr]),
+            np.concatenate([n_new, n_out_arr]))
+        kp, kn = len(p_new), len(n_new)
+        # --- insert: new-vs-old (containers pre-insert) --------------- #
+        less, eq = self._host_adjust(self._neg, p_new,
+                                     ln[:kp], lqn[:kp])
+        d = int(2 * less.sum() + eq.sum())
+        less2, eq2 = self._host_adjust(self._pos, n_new,
+                                       lp[:kn], lqp[:kn])
+        greater = self._pos.size - less2 - eq2
+        d += int(2 * greater.sum() + eq2.sum())
+        d += self._cross2_arrays(p_new, n_new)
+        self._wins2 += d
+        self._pos.buf.extend(p_new.tolist())
+        self._neg.buf.extend(n_new.tolist())
+        for s, is_pos in zip(scores.tolist(), labels.tolist()):
+            self._log.append((s, is_pos))
+        # --- eviction: inclusion-exclusion (containers post-insert) --- #
+        if n_evict:
+            less, eq = self._host_adjust(self._neg, p_out_arr,
+                                         ln[kp:], lqn[kp:])
+            d = int(2 * less.sum() + eq.sum())
+            less2, eq2 = self._host_adjust(self._pos, n_out_arr,
+                                           lp[kn:], lqp[kn:])
+            greater = self._pos.size - less2 - eq2
+            d += int(2 * greater.sum() + eq2.sum())
+            d -= self._cross2_arrays(p_out_arr, n_out_arr)
+            self._wins2 -= d
+            for _ in range(n_evict):
+                v, is_pos = self._log.popleft()
+                side = self._pos if is_pos else self._neg
+                try:
+                    # only the UNSNAPSHOTTED suffix is removable in
+                    # place (an in-flight build owns the prefix)
+                    i = side.buf.index(v, side.snap_buf)
+                    side.buf.pop(i)
+                except ValueError:
+                    side.tomb.append(v)
+            self.n_evicted += n_evict
+            self._update_gauges()
 
     def _evict(self, count: int) -> None:
         """Remove the ``count`` oldest arrivals from the statistic."""
@@ -823,14 +1061,24 @@ class ExactAucIndex:
         side.placed_base = side.base
         return shipped
 
-    def _warm_counts(self, base_dev, cap: int, deltas) -> None:
+    def _warm_counts(self, base_dev, cap: int, deltas,
+                     side: Optional[_ClassSide] = None) -> None:
         """Force-compile the count kernel for a placement geometry the
         request path is ABOUT to see (called on the compactor thread
         before the swap, with every query bucket observed so far):
         XLA compiles of new (base cap, delta cap, q bucket) shapes
         otherwise land on the first post-swap count — a request-thread
-        pause the background compactor exists to remove."""
+        pause the background compactor exists to remove.
+
+        Kernel mode [ISSUE 10] warms the fused Pallas fn instead: the
+        single-side shape (score path) AND — when ``side`` is given —
+        the two-side insert shape against the OTHER side's current
+        runs, so the post-swap insert's combined geometry is compiled
+        too."""
         if base_dev is None and not deltas:
+            return
+        if self._ck:
+            self._warm_counts_fused(base_dev, cap, deltas, side)
             return
         from tuplewise_tpu.parallel.sharded_counts import sharded_counts
 
@@ -846,6 +1094,56 @@ class ExactAucIndex:
                 self._warmed.add(key)
             except Exception:   # noqa: BLE001 — warming is best-effort
                 return
+
+    def _warm_counts_fused(self, base_dev, cap: int, deltas,
+                           side: Optional[_ClassSide]) -> None:
+        """Kernel-variant prewarm: one dispatch per (geometry, q
+        bucket) through the same ``signed_pair_counts`` entry the
+        request path uses — compiles (and interpret-mode traces) land
+        here, on the compactor thread. No metrics: warm dispatches
+        must not inflate the per-micro-batch call witness."""
+        from tuplewise_tpu.parallel.sharded_counts import (
+            signed_pair_counts,
+        )
+
+        runs = ([(base_dev, cap, 1)] if base_dev is not None else [])
+        runs += [(d, c, 1) for d, c in deltas]
+        if side is not None and side.tomb_dev is not None:
+            runs.append((side.tomb_dev, side.tomb_cap, -1))
+        other_runs = []
+        if side is not None:
+            # READ the other side's current placements only — no
+            # _kernel_runs here: its lazy tombstone re-place mutates
+            # placement fields, and this thread does not hold the
+            # lock. A torn read just warms a slightly-off geometry;
+            # warming is best-effort either way.
+            other = self._neg if side is self._pos else self._pos
+            if other.base_dev is not None:
+                other_runs.append((other.base_dev, other.cap, 1))
+            if other.delta_dev is not None:
+                other_runs.append((other.delta_dev, other.delta_cap, 1))
+            if other.tomb_dev is not None:
+                other_runs.append((other.tomb_dev, other.tomb_cap, -1))
+        shapes = [(tuple((c, s) for _, c, s in runs), ())]
+        if other_runs:
+            shapes.append((tuple((c, s) for _, c, s in runs),
+                           tuple((c, s) for _, c, s in other_runs)))
+        for qb in sorted(self._q_buckets):
+            for shape_a, shape_b in shapes:
+                key = ("ck", shape_a, shape_b, qb)
+                if key in self._warmed:
+                    continue
+                try:
+                    signed_pair_counts(
+                        self._mesh, runs,
+                        other_runs if shape_b else (),
+                        np.zeros(qb, dtype=self.dtype),
+                        np.zeros(qb if shape_b else 0,
+                                 dtype=self.dtype),
+                        self.dtype, kernel=self._ck_interp)
+                    self._warmed.add(key)
+                except Exception:  # noqa: BLE001 — best-effort
+                    return
 
     # ------------------------------------------------------------------ #
     # compaction tiers [ISSUE 5]                                         #
@@ -935,6 +1233,9 @@ class ExactAucIndex:
             side.tomb_run = _splice_merge(
                 side.tomb_run,
                 np.sort(np.asarray(tomb_vals, dtype=self.dtype)))
+            # kernel mode mirrors the tombstone multiset on-mesh (the
+            # kernel subtracts it in-dispatch) [ISSUE 10]
+            self._replace_tomb(side)
         self.n_compactions += 1
         self._c_compactions.inc()
         self._update_gauges()
@@ -1058,6 +1359,7 @@ class ExactAucIndex:
         side.delta_rows = None
         side.delta_minors = 0
         side.tomb_run = np.empty(0, dtype=self.dtype)
+        self._replace_tomb(side)    # clears the device mirror
         shipped = self._place(side)
         if not self._delta:
             # in host-merge mode this IS the minor compaction — the
@@ -1140,7 +1442,7 @@ class ExactAucIndex:
                         metrics=self.metrics, chaos=self.chaos)
             else:
                 base_dev, cap, shipped = None, 0, 0
-            self._warm_counts(base_dev, cap, ())
+            self._warm_counts(base_dev, cap, (), side=side)
             with self._cv:
                 t0 = time.perf_counter()
                 side.base = merged
@@ -1190,7 +1492,7 @@ class ExactAucIndex:
             new_delta, placed = self._build_delta(side, buf_snap)
         if placed is not None:
             self._warm_counts(side.base_dev, side.cap,
-                              ((placed[0], placed[1]),))
+                              ((placed[0], placed[1]),), side=side)
         with self._cv:
             t0 = time.perf_counter()
             self._commit_minor(side, new_delta, placed, tomb_snap, t0)
@@ -1205,7 +1507,7 @@ class ExactAucIndex:
             t0 = time.perf_counter()
             with maybe_span(self.tracer, "compactor.major_build"):
                 merged, dev, cap = self._major_build(side)
-            self._warm_counts(dev, cap, ())
+            self._warm_counts(dev, cap, (), side=side)
             with self._cv:
                 self._commit_major(side, merged, dev, cap, t0,
                                    time.perf_counter())
@@ -1226,7 +1528,7 @@ class ExactAucIndex:
                                          chaos=self.chaos)
             else:
                 dev, cap = None, 0
-            self._warm_counts(dev, cap, ())
+            self._warm_counts(dev, cap, (), side=side)
             with self._cv:
                 t0 = time.perf_counter()
                 side.base = merged
@@ -1237,6 +1539,7 @@ class ExactAucIndex:
                 side.delta_rows = None
                 side.delta_minors = 0
                 side.tomb_run = np.empty(0, dtype=self.dtype)
+                self._replace_tomb(side)    # clears the device mirror
                 self.n_compactions += 1
                 self._c_compactions.inc()
                 self._update_gauges()
@@ -1336,6 +1639,7 @@ class ExactAucIndex:
                 side.placed_base = None
                 self._place(side)
                 self._replace_deltas(side)
+                self._replace_tomb(side)
             self._update_gauges()
 
     def export_state(self) -> Tuple[np.ndarray, np.ndarray, list, int,

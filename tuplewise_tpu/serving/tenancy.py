@@ -337,6 +337,7 @@ class TenantFleetIndex:
                  whale_threshold: Optional[int] = None,
                  whale_demote_fraction: float = 0.5,
                  incremental_placement: bool = True,
+                 count_kernel: bool = False,
                  tracer=None, flight=None):
         if window is not None and window < 2:
             raise ValueError(f"window must be >= 2, got {window}")
@@ -362,6 +363,23 @@ class TenantFleetIndex:
             if whale_threshold is not None else 0)
         self.incremental_placement = incremental_placement
         self.dtype = np.float32
+        # Pallas-fused fleet counts [ISSUE 10]: ONE tenant-axis kernel
+        # invocation per device per coalesced micro-batch instead of
+        # the vmapped searchsorted quartet; opt-in + env override via
+        # the shared resolver, automatic XLA fallback inside the
+        # dispatcher (tenant_pack_counts)
+        self.count_kernel = bool(count_kernel)
+        self._ck = False
+        self._ck_interp = False
+        if count_kernel or os.environ.get("TUPLEWISE_SERVING_PALLAS"):
+            import jax
+
+            from tuplewise_tpu.ops.pallas_modes import (
+                resolve_serving_counts_mode,
+            )
+
+            self._ck, self._ck_interp = resolve_serving_counts_mode(
+                jax.default_backend(), count_kernel)
         self.chaos = chaos
         self.shard_retries = shard_retries
         self.tracer = tracer
@@ -415,6 +433,15 @@ class TenantFleetIndex:
         self._g_whales = self.metrics.gauge("fleet_whales")
         self._c_bg_restarts = self.metrics.counter(
             "bg_compactor_restarts")
+        # fused-count observability [ISSUE 10]
+        self.metrics.counter("count_kernel_calls_total")
+        self.metrics.counter("count_kernel_fallbacks_total")
+        # prewarm bookkeeping [ISSUE 10 satellite]: query buckets seen
+        # so far × pack geometry — the off-batcher build path warms
+        # the count fns for them so compiles stay off the request
+        # thread (the single-index _warm_counts discipline)
+        self._q_buckets: set = set()
+        self._warmed: set = set()
         self.last_compactor_error = None
         self._healer = None
         if shards is not None:
@@ -614,6 +641,7 @@ class TenantFleetIndex:
             z = [np.zeros(0, dtype=np.int64) for _ in slots]
             return list(z), list(z), list(z), list(z)
         qb = next_bucket(longest)
+        self._q_buckets.add(qb)
         tb = self._t_bucket()
         qn = np.zeros((tb, qb), dtype=self.dtype)
         qp = np.zeros((tb, qb), dtype=self.dtype)
@@ -628,7 +656,9 @@ class TenantFleetIndex:
             return tenant_pack_counts(
                 self._mesh, self._pos_pack.dev, self._pos_pack.cap,
                 self._neg_pack.dev, self._neg_pack.cap, tb, qn, qp,
-                self.dtype, chaos=self.chaos)
+                self.dtype, chaos=self.chaos,
+                kernel=(self._ck_interp if self._ck else None),
+                metrics=self.metrics)
 
         try:
             with maybe_span(self.tracer, "fleet.count",
@@ -1009,6 +1039,47 @@ class TenantFleetIndex:
                          or tomb_pending >= self.compact_every)):
                 self._submit_compact(st)
             self._cv.notify_all()
+        # still on the compactor thread: pre-compile the count fns for
+        # the geometry the next request-path count will see [ISSUE 10]
+        self._warm_fleet_counts()
+
+    def _warm_fleet_counts(self) -> None:
+        """Best-effort prewarm of the fleet KERNEL count fn for the
+        CURRENT pack geometry × every query bucket observed so far —
+        called on the side compactor thread after a build, so a new
+        kernel trace/compile lands there instead of on the request
+        thread [ISSUE 10 satellite]. Kernel mode only: the XLA fns
+        are globally lru-cached and cheap to hit cold, and warming
+        them here would add a wasted dispatch per build to every
+        kernel-off fleet (the pre-PR-10 behavior had none). No
+        metrics: warm dispatches must not inflate the
+        one-call-per-batch witness."""
+        if not self._ck:
+            return
+        from tuplewise_tpu.parallel.sharded_counts import (
+            tenant_pack_counts,
+        )
+
+        with self._lock:
+            tb = self._pos_pack.t_bucket
+            cap_p, cap_n = self._pos_pack.cap, self._neg_pack.cap
+            pos_dev, neg_dev = self._pos_pack.dev, self._neg_pack.dev
+            qbs = sorted(self._q_buckets)
+        if pos_dev is None or neg_dev is None or not tb:
+            return
+        for qb in qbs:
+            key = (self._ck, tb, cap_p, cap_n, qb)
+            if key in self._warmed:
+                continue
+            try:
+                tenant_pack_counts(
+                    self._mesh, pos_dev, cap_p, neg_dev, cap_n, tb,
+                    np.zeros((tb, qb), dtype=self.dtype),
+                    np.zeros((tb, qb), dtype=self.dtype), self.dtype,
+                    kernel=(self._ck_interp if self._ck else None))
+                self._warmed.add(key)
+            except Exception:   # noqa: BLE001 — warming is best-effort
+                return
 
     def wait_idle(self, timeout: float = 30.0) -> None:
         """Block until no background tenant build is queued or in
@@ -1053,6 +1124,7 @@ class TenantFleetIndex:
                   engine="jax", metrics=self.metrics, chaos=self.chaos,
                   bg_compact=self.bg_compact,
                   shard_retries=self.shard_retries,
+                  count_kernel=self.count_kernel,
                   tracer=self.tracer, flight=self.flight)
         if self._mesh is not None:
             kw["mesh"] = self._mesh
@@ -1340,6 +1412,7 @@ class MultiTenantEngine:
             bg_compact=config.bg_compact,
             whale_threshold=self.tenancy.whale_threshold,
             whale_demote_fraction=self.tenancy.whale_demote_fraction,
+            count_kernel=config.count_kernel,
             tracer=tracer, flight=self.flight)
         # bounded metric cardinality [ISSUE 9 satellite]: tenants past
         # tenant_metric_cap share ONE {tenant=__other__} series
