@@ -61,6 +61,19 @@ timeout -k 10 360 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
+# Controller smoke [ISSUE 11]: a Zipf flash crowd at T=32/S=2 served
+# twice — the SLO-driven FleetController keeps the controlled fleet's
+# verdict healthy (typed per-tenant throttling BEFORE the breach, zero
+# hard rejects, per-tenant wins2 bit-identical to independents through
+# every actuation) while the uncontrolled twin breaches; `tuplewise
+# doctor` must then attribute 100% of the actuations to the signal
+# that caused them. Writes results/controller_smoke.jsonl.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/controller_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 # Chaos smoke [ISSUE 3]: a seeded fault schedule (shard death +
 # compactor crash + batcher crash + poison events) through replay;
 # asserts every recovery counter fired and the final AUC is

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""SLO-driven control plane smoke [ISSUE 11]: a Zipf flash crowd at
+T=32 over 2 mesh shards, served twice from the same schedule —
+
+* **controlled** — a ``FleetController`` rides the live SLO monitor
+  (real ``MetricsFlusher`` observer wiring, the exact ``serve
+  --controller-spec`` path): it must throttle the flooding tenant
+  typed (``TenantThrottledError`` + retry hint) BEFORE the breach, so
+  the run ends with the SLO verdict **healthy**, ZERO hard rejects
+  for in-quota tenants, and per-tenant wins2 bit-identical to
+  independent single-tenant indexes over the admitted events;
+* **uncontrolled twin** — the same schedule with no controller must
+  **breach** (queue saturation and/or hard-reject flood), proving the
+  scenario actually needs defending.
+
+Then ``tuplewise doctor`` runs over the controlled run's artifacts
+(metrics.jsonl + flight.jsonl) and must attribute **100 % of the
+actuations** to the signal that caused them (cause → action → effect
+correlation) with a non-degraded verdict.
+
+Writes ``results/controller_smoke.jsonl`` for the CI artifact.
+Run via scripts/ci.sh.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import dataclasses  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from tuplewise_tpu.obs.metrics_export import MetricsFlusher  # noqa: E402
+from tuplewise_tpu.obs.slo import SloMonitor  # noqa: E402
+from tuplewise_tpu.serving import (  # noqa: E402
+    BackpressureError, ExactAucIndex, FleetController,
+    MultiTenantEngine, ServingConfig, TenancyConfig,
+    TenantThrottledError,
+)
+
+T = 32
+SHARDS = 2
+QUEUE = 128
+BURST = 256
+ROUNDS = 4
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "results", "controller_smoke.jsonl")
+
+SLO_SPEC = {"objectives": [
+    {"name": "queue_sat", "type": "saturation",
+     "metric": "queue_depth_live", "capacity": "queue_size",
+     "max_fraction": 0.8},
+    {"name": "no_hard_rejects", "type": "counter_max",
+     "metric": "rejected_total", "max": 0},
+]}
+
+# throttle_s is deliberately long: on a loaded CI box the submitting
+# thread can stall for seconds mid-burst, and a short throttle that
+# expires inside such a stall would let the flood through between two
+# checkpoints. Reversibility is preserved — the calm release clears
+# throttles as soon as pressure subsides. The budgets are sized for
+# the run: the flusher evaluates every 20 ms on top of the burst
+# checkpoints, and with the 20 ms cooldown a sustained-pressure run
+# spends ~50 shed steps/s — a budget that exhausted mid-scenario
+# would (by design!) let the tail of the flood through, which is
+# exactly the "budget bounds the blast radius" semantics, but not
+# what this smoke is pinning.
+CTL_SPEC = {"knobs": ["shed", "flush"], "cooldown_s": 0.02,
+            "up_ticks": 1, "down_ticks": 8, "throttle_s": 5.0,
+            "shed_budget": 2048, "flush_budget": 64}
+
+
+def run(controlled, artifact_dir=None):
+    rng = np.random.default_rng(31)
+    cfg = ServingConfig(queue_size=QUEUE, policy="reject",
+                        flush_timeout_s=0.001, max_batch=32,
+                        mesh_shards=SHARDS)
+    admitted = {}
+    metrics_path = (os.path.join(artifact_dir, "metrics.jsonl")
+                    if artifact_dir else None)
+    with MultiTenantEngine(cfg, TenancyConfig(
+            max_tenants=T + 8, tenant_quota=8192)) as eng:
+        mon = SloMonitor(SLO_SPEC, registry=eng.metrics,
+                         flight=eng.flight,
+                         context=dataclasses.asdict(cfg))
+        if controlled:
+            FleetController(eng, CTL_SPEC).attach(mon)
+        flusher = MetricsFlusher(
+            eng.metrics, metrics_path, every_s=0.02,
+            meta={"stage": "controller_smoke"}, config=cfg,
+            observers=[mon.observe_row]).start()
+        shed = rejected = 0
+        for r in range(ROUNDS):
+            # steady state: every tenant a small resolved batch
+            futs = []
+            for k in range(1, T):
+                s = rng.standard_normal(8)
+                l = rng.random(8) < 0.5
+                futs.append((f"t{k}", s, l,
+                             eng.insert(f"t{k}", s, l)))
+                if len(futs) >= 32:
+                    for tid, s_, l_, f in futs:
+                        f.result(30.0)
+                        admitted.setdefault(tid, []).append((s_, l_))
+                    futs = []
+            for tid, s_, l_, f in futs:
+                f.result(30.0)
+                admitted.setdefault(tid, []).append((s_, l_))
+            # the wedge: one big polite insert occupies the batcher
+            ws = rng.standard_normal(100_000)
+            wl = rng.random(100_000) < 0.5
+            wedge = eng.insert(f"t{T - 1}", ws, wl)
+            admitted.setdefault(f"t{T - 1}", []).append((ws, wl))
+            # the flash crowd: t0 floods while the batcher is busy.
+            # The flusher keeps writing rows (the doctor artifacts),
+            # and the monitor is ALSO pumped at burst checkpoints so
+            # the control decision does not hinge on a 20 ms timer
+            # landing inside the warn window — the same deterministic
+            # pumping the tier-1 scenario suite uses.
+            for i in range(BURST):
+                s = rng.standard_normal(1)
+                l = rng.random(1) < 0.5
+                try:
+                    eng.insert("t0", s, l)
+                    admitted.setdefault("t0", []).append((s, l))
+                except TenantThrottledError:
+                    shed += 1
+                except BackpressureError:
+                    rejected += 1
+                if (i + 1) % 20 == 0:
+                    mon.observe(eng.metrics.snapshot(),
+                                time.perf_counter())
+                    time.sleep(0.005)
+            wedge.result(120.0)
+            eng.flush(timeout=120.0)
+            time.sleep(0.1)
+        flusher.stop()
+        slo = mon.report()
+        m = eng.metrics.snapshot()
+        wins = {t: eng.fleet.wins2(t) for t in eng.fleet.tenants()}
+        flight = eng.flight
+        acts = flight.events("actuation")
+        if artifact_dir:
+            flight.dump_to(os.path.join(artifact_dir, "flight.jsonl"))
+    oracle = {}
+    for tid, batches in admitted.items():
+        idx = ExactAucIndex(engine="jax")
+        idx.insert_batch(np.concatenate([s for s, _ in batches]),
+                         np.concatenate([l for _, l in batches]))
+        oracle[tid] = idx._wins2
+    return {
+        "slo_healthy": slo["healthy"],
+        "slo": slo,
+        "shed": shed,
+        "rejected": rejected,
+        "rejected_total": m["rejected_total"]["value"],
+        "tenant_rejected_total": m["tenant_rejected_total"]["value"],
+        "tenant_throttled_total": m["tenant_throttled_total"]["value"],
+        "actuations": len(acts),
+        "actuation_signals_nonnull": sum(1 for a in acts
+                                         if a.get("signal")),
+        "parity": wins == oracle,
+        "wins_mismatch": sorted(t for t in wins
+                                if wins[t] != oracle.get(t))[:5],
+    }
+
+
+def main() -> int:
+    rec = {"stage": "controller_smoke", "tenants": T,
+           "mesh_shards": SHARDS, "queue_size": QUEUE, "burst": BURST}
+
+    with tempfile.TemporaryDirectory() as art:
+        c = run(controlled=True, artifact_dir=art)
+        rec["controlled"] = {k: v for k, v in c.items() if k != "slo"}
+        print(f"[controller_smoke] controlled: healthy="
+              f"{c['slo_healthy']} throttled="
+              f"{c['tenant_throttled_total']} rejects="
+              f"{c['rejected_total']} actuations={c['actuations']}",
+              file=sys.stderr)
+        assert c["slo_healthy"], \
+            f"controlled fleet breached its SLO: {c['slo']}"
+        assert c["rejected_total"] == 0, \
+            "controlled fleet hard-rejected in-quota traffic"
+        assert c["tenant_rejected_total"] == 0
+        assert c["tenant_throttled_total"] > 0, \
+            "controller never shed — the scenario did not exercise it"
+        assert c["actuations"] > 0
+        assert c["actuation_signals_nonnull"] == c["actuations"], \
+            "actuation without a triggering signal"
+        assert c["parity"], \
+            f"wins2 diverged from independents: {c['wins_mismatch']}"
+
+        # doctor attribution over the controlled run's artifacts
+        from tuplewise_tpu.obs.doctor import diagnose
+
+        report = diagnose(run_dir=art, slo_spec=SLO_SPEC,
+                          context={"queue_size": QUEUE})
+        acts = report.get("actuations") or {}
+        rec["doctor"] = {"verdict": report["verdict"],
+                         "actuations": acts.get("total", 0),
+                         "attributed": acts.get("attributed", 0)}
+        print(f"[controller_smoke] doctor: {rec['doctor']}",
+              file=sys.stderr)
+        assert acts.get("total", 0) == c["actuations"], \
+            (acts, c["actuations"])
+        assert acts["attributed"] == acts["total"], \
+            f"doctor could not attribute every actuation: {acts}"
+        assert not report["verdict"].startswith("degraded"), \
+            report["verdict"]
+
+    u = run(controlled=False)
+    rec["uncontrolled"] = {k: v for k, v in u.items() if k != "slo"}
+    print(f"[controller_smoke] uncontrolled twin: healthy="
+          f"{u['slo_healthy']} rejects={u['rejected_total']}",
+          file=sys.stderr)
+    assert not u["slo_healthy"], \
+        "uncontrolled twin did not breach — the scenario is vacuous"
+    assert u["parity"], "parity must hold even while breaching"
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,7 @@ def test_streaming_bench_emits_one_json_line():
          "--n-events", "400", "--baseline-events", "100",
          "--max-batch", "32", "--delta-bench-n", "0",
          "--tenant-bench-n", "0", "--fleet-bench-n", "0",
-         "--kernel-bench-n", "0"],
+         "--kernel-bench-n", "0", "--controller-bench-n", "0"],
         capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]

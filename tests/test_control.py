@@ -1,0 +1,819 @@
+"""SLO-driven control plane [ISSUE 11]: FleetController knobs,
+hysteresis/rate-limit/budget discipline, typed throttling, deadline
+reaper, mesh resize, slope promotion, doctor attribution, and the
+chaos-style scenario suite (controlled fleet defends the SLO an
+uncontrolled twin breaches, with per-tenant wins2 bit-identical to
+independents through every actuation).
+
+The scenario harness is deterministic: SLO evaluations are pumped
+manually (``SloMonitor.observe`` with an explicit clock), backlog is
+built by wedging the batcher behind one large insert, and bursts are
+interleaved with observations — no reliance on thread scheduling for
+the control decisions themselves.
+"""
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.obs.slo import SloMonitor
+from tuplewise_tpu.serving import (
+    BackpressureError,
+    ControllerConfig,
+    DeadlineExceededError,
+    ExactAucIndex,
+    FleetController,
+    MicroBatchEngine,
+    MultiTenantEngine,
+    ServingConfig,
+    TenancyConfig,
+    TenantFleetIndex,
+    TenantThrottledError,
+)
+from tuplewise_tpu.serving.control import ControllerSpecError, _Knob
+
+SAT_SPEC = {"objectives": [
+    {"name": "queue_sat", "type": "saturation",
+     "metric": "queue_depth_live", "capacity": "queue_size",
+     "max_fraction": 0.8},
+    {"name": "no_hard_rejects", "type": "counter_max",
+     "metric": "rejected_total", "max": 0},
+]}
+
+FAST_CTL = {"cooldown_s": 0.0, "up_ticks": 1, "down_ticks": 2}
+
+
+def _observe(mon, eng, ts):
+    mon.observe(eng.metrics.snapshot(), ts)
+
+
+# --------------------------------------------------------------------- #
+# spec + knob discipline                                                 #
+# --------------------------------------------------------------------- #
+
+class TestControllerSpec:
+    def test_defaults_and_json_roundtrip(self):
+        cfg = ControllerConfig.from_spec(None)
+        assert cfg.enabled and set(cfg.knobs) == {
+            "shed", "flush", "weights", "mesh", "promote"}
+        cfg2 = ControllerConfig.from_spec(
+            json.dumps({"knobs": ["shed"], "cooldown_s": 1.5}))
+        assert cfg2.knobs == ("shed",) and cfg2.cooldown_s == 1.5
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ControllerSpecError):
+            ControllerConfig.from_spec({"coolness": 11})
+        with pytest.raises(ControllerSpecError):
+            ControllerConfig.from_spec({"knobs": ["turbo"]})
+
+    def test_at_file(self, tmp_path):
+        p = tmp_path / "ctl.json"
+        p.write_text(json.dumps({"throttle_s": 0.25}))
+        assert ControllerConfig.from_spec(
+            "@" + str(p)).throttle_s == 0.25
+
+
+class TestKnobDiscipline:
+    def test_hysteresis_needs_consecutive_pressure(self):
+        k = _Knob("x", cooldown_s=0.0, budget=100, up_ticks=3,
+                  down_ticks=2, max_level=5)
+        t = 0.0
+        # interrupted streaks never actuate
+        for want in (1, 1, 0, 1, 1, None, 1, 1):
+            assert k.tick(want, t) == 0
+            t += 1.0
+        assert k.tick(1, t) == 1     # third consecutive
+        assert k.level == 1
+
+    def test_cooldown_rate_limits(self):
+        k = _Knob("x", cooldown_s=1.0, budget=100, up_ticks=1,
+                  down_ticks=1, max_level=100)
+        steps = sum(abs(k.tick(1, 0.1 * i)) for i in range(100))
+        # 9.9 simulated seconds / 1 s cooldown -> at most 10 steps
+        assert steps <= 10
+
+    def test_budget_bounds_pressured_steps_but_not_homecoming(self):
+        k = _Knob("x", cooldown_s=0.0, budget=3, up_ticks=1,
+                  down_ticks=1, max_level=100)
+        t = 0.0
+        ups = 0
+        for _ in range(50):
+            ups += max(0, k.tick(1, t))
+            t += 1.0
+        assert ups == 3 and k.used == 3
+        downs = 0
+        for _ in range(50):
+            downs += -min(0, k.tick(0, t))
+            t += 1.0
+        assert downs == 3 and k.level == 0   # reverts ran budget-free
+
+    def test_randomized_schedule_no_flap(self):
+        rng = np.random.default_rng(7)
+        k = _Knob("x", cooldown_s=0.5, budget=1000, up_ticks=2,
+                  down_ticks=4, max_level=4, min_level=-2)
+        t = 0.0
+        moves = []
+        for _ in range(500):
+            want = int(rng.integers(-1, 2))
+            s = k.tick(want, t)
+            if s:
+                moves.append(t)
+            t += 0.05
+        # rate limit: never two actuations inside one cooldown window
+        assert all(b - a >= 0.5 for a, b in zip(moves, moves[1:]))
+        # 25 simulated seconds / 0.5 cooldown -> hard per-window bound
+        assert len(moves) <= 25 / 0.5 + 1
+        assert -2 <= k.level <= 4
+
+
+# --------------------------------------------------------------------- #
+# typed throttling + per-tenant overrides                                #
+# --------------------------------------------------------------------- #
+
+class TestThrottle:
+    def test_throttle_is_typed_expiring_and_counted(self):
+        with MultiTenantEngine(ServingConfig(flush_timeout_s=0.001),
+                               TenancyConfig()) as eng:
+            eng.throttle_tenant("hot", retry_after_s=0.2)
+            with pytest.raises(TenantThrottledError) as ei:
+                eng.insert("hot", 1.0, 1)
+            assert ei.value.tenant == "hot"
+            assert 0 < ei.value.retry_after_s <= 0.2
+            # other tenants unaffected
+            assert eng.insert("calm", 1.0, 1).result(10.0) == 1
+            time.sleep(0.25)
+            assert eng.insert("hot", 1.0, 1).result(10.0) == 1
+            m = eng.metrics.snapshot()
+            assert m["tenant_throttled_total"]["value"] == 1
+            assert m["tenant_throttled_total{tenant=hot}"]["value"] == 1
+            kinds = [e["kind"] for e in eng.flight.events()]
+            assert "tenant_throttled" in kinds
+
+    def test_clear_throttles(self):
+        with MultiTenantEngine(ServingConfig(),
+                               TenancyConfig()) as eng:
+            eng.throttle_tenant("a", 30.0)
+            eng.throttle_tenant("b", 30.0)
+            assert sorted(eng.throttled_tenants()) == ["a", "b"]
+            assert eng.clear_throttles("a") == 1
+            assert eng.clear_throttles() == 1
+            assert eng.insert("a", 1.0, 1).result(10.0) == 1
+
+    def test_weight_and_quota_overrides(self):
+        with MultiTenantEngine(
+                ServingConfig(flush_timeout_s=0.2, max_batch=64),
+                TenancyConfig(tenant_quota=4, weight=2)) as eng:
+            eng.set_tenant_quota("big", 64)
+            # default quota would reject the 5th queued request; the
+            # override admits far more
+            futs = [eng.insert("big", float(i), i % 2)
+                    for i in range(32)]
+            for f in futs:
+                f.result(10.0)
+            eng.set_tenant_weight("big", 16)
+            assert eng._tenant_weights["big"] == 16
+            eng.set_tenant_weight("big", None)
+            assert "big" not in eng._tenant_weights
+
+    def test_controller_off_is_todays_behavior(self):
+        """No controller: no throttles, no overrides, no controller
+        metrics/flight kinds — the pre-ISSUE-11 engine, byte for
+        byte."""
+        scores, labels = (np.random.default_rng(3).standard_normal(200),
+                          np.random.default_rng(4).random(200) < 0.5)
+        with MultiTenantEngine(ServingConfig(flush_timeout_s=0.001),
+                               TenancyConfig()) as eng:
+            singles = {}
+            for i in range(0, 200, 10):
+                tid = f"t{(i // 10) % 4}"
+                eng.insert(tid, scores[i:i + 10],
+                           labels[i:i + 10]).result(10.0)
+                singles.setdefault(tid, ExactAucIndex(
+                    engine="jax")).insert_batch(scores[i:i + 10],
+                                                labels[i:i + 10])
+            eng.flush()
+            assert not eng._throttles and not eng._tenant_weights \
+                and not eng._tenant_quotas
+            m = eng.metrics.snapshot()
+            assert "controller_actuations_total" not in m
+            assert m["tenant_throttled_total"]["value"] == 0
+            assert not eng.flight.events("actuation")
+            for tid, idx in singles.items():
+                assert eng.fleet.wins2(tid) == idx._wins2
+
+
+# --------------------------------------------------------------------- #
+# deadline reaper [ISSUE 11 bugfix]                                      #
+# --------------------------------------------------------------------- #
+
+class TestDeadlineReaper:
+    def test_wedged_batcher_expires_queued_requests(self):
+        """Regression: dispatch-time expiry (engine.py) never runs
+        while the batcher is wedged mid-apply — the timer must fail
+        the rotting request typed, long before the wedge clears."""
+        eng = MicroBatchEngine(ServingConfig(
+            deadline_s=0.1, flush_timeout_s=0.001, max_batch=1))
+        orig = eng.index.insert_batch
+
+        def wedge(s, l):
+            time.sleep(1.2)
+            return orig(s, l)
+
+        eng.index.insert_batch = wedge
+        try:
+            eng.insert(1.0, 1)          # dispatched, wedges the batcher
+            time.sleep(0.05)
+            t0 = time.perf_counter()
+            f2 = eng.insert(2.0, 0)     # rots in the queue
+            with pytest.raises(DeadlineExceededError):
+                f2.result(timeout=0.8)
+            waited = time.perf_counter() - t0
+            # the old dispatch-only path could not fail it before the
+            # wedge cleared at ~1.2 s
+            assert waited < 0.8, waited
+            assert eng.metrics.snapshot()[
+                "deadline_expired_total"]["value"] >= 1
+            assert any(e["kind"] == "deadline_expired"
+                       for e in eng.flight.events())
+        finally:
+            eng.index.insert_batch = orig
+            eng.close()
+
+    def test_expiry_is_counted_once(self):
+        """Reaper and dispatch both see a stale request — exactly one
+        of them wins and the counter moves once per request."""
+        eng = MicroBatchEngine(ServingConfig(
+            deadline_s=0.05, flush_timeout_s=0.001, max_batch=1))
+        orig = eng.index.insert_batch
+
+        def wedge(s, l):
+            time.sleep(0.4)
+            return orig(s, l)
+
+        eng.index.insert_batch = wedge
+        try:
+            eng.insert(1.0, 1)
+            time.sleep(0.02)
+            futs = [eng.insert(float(i), i % 2) for i in range(4)]
+            for f in futs:
+                with pytest.raises(DeadlineExceededError):
+                    f.result(timeout=1.0)
+            time.sleep(0.5)     # let the wedge clear + batcher drain
+            assert eng.metrics.snapshot()[
+                "deadline_expired_total"]["value"] == 4
+        finally:
+            eng.index.insert_batch = orig
+            eng.close()
+
+    def test_fleet_reaper_frees_quota(self):
+        eng = MultiTenantEngine(
+            ServingConfig(deadline_s=0.08, flush_timeout_s=0.001),
+            TenancyConfig(tenant_quota=2))
+        orig = eng.fleet.apply_inserts
+
+        def wedge(items):
+            time.sleep(0.6)
+            return orig(items)
+
+        eng.fleet.apply_inserts = wedge
+        try:
+            f0 = eng.insert("a", 1.0, 1)    # wedges the batcher
+            time.sleep(0.02)
+            f1 = eng.insert("b", 1.0, 1)
+            f2 = eng.insert("b", 2.0, 0)    # quota full for b
+            for f in (f1, f2):
+                with pytest.raises(DeadlineExceededError):
+                    f.result(timeout=1.0)
+            # reaper REMOVED them: quota slots free again (submit
+            # succeeds where the quota would have rejected); un-wedge
+            # before the new request's own deadline can expire
+            eng.fleet.apply_inserts = orig
+            f0.result(timeout=5.0)
+            f3 = eng.insert("b", 3.0, 1)
+            assert f3.result(timeout=5.0) == 1
+            assert eng.metrics.snapshot()[
+                "deadline_expired_total"]["value"] == 2
+        finally:
+            eng.fleet.apply_inserts = orig
+            eng.close()
+
+
+# --------------------------------------------------------------------- #
+# mesh resize                                                            #
+# --------------------------------------------------------------------- #
+
+class TestMeshResize:
+    def test_resize_parity_grow_and_shrink(self):
+        fleet = TenantFleetIndex(shards=2, compact_every=32)
+        singles = {}
+        rng = np.random.default_rng(5)
+
+        def feed(k):
+            items = []
+            for t in range(6):
+                s = rng.standard_normal(k)
+                l = rng.random(k) < 0.5
+                tid = f"t{t}"
+                items.append((tid, s, l))
+                singles.setdefault(tid, ExactAucIndex(
+                    compact_every=32, engine="jax")).insert_batch(s, l)
+            fleet.apply_inserts(items)
+
+        feed(60)
+        assert fleet.resize_shards(4)
+        assert fleet.shards == 4
+        feed(60)
+        assert fleet.resize_shards(1)
+        feed(60)
+        assert not fleet.resize_shards(1)      # no-op width
+        assert not fleet.resize_shards(1024)   # beyond the pool
+        for tid, idx in singles.items():
+            assert fleet.wins2(tid) == idx._wins2
+            assert fleet.auc(tid) == idx.auc()
+        m = fleet.metrics.snapshot()
+        assert m["mesh_width"]["value"] == 1
+        assert m["reshard_events"]["value"] >= 2
+        fleet.close()
+
+    def test_unsharded_fleet_refuses(self):
+        fleet = TenantFleetIndex()
+        assert not fleet.resize_shards(2)
+        fleet.close()
+
+
+# --------------------------------------------------------------------- #
+# controller knobs end-to-end (deterministic pumping)                    #
+# --------------------------------------------------------------------- #
+
+class TestControllerKnobs:
+    def test_flush_widen_and_restore(self):
+        with MultiTenantEngine(
+                ServingConfig(queue_size=64, flush_timeout_s=0.001,
+                              max_batch=32),
+                TenancyConfig()) as eng:
+            mon = SloMonitor(SAT_SPEC, registry=eng.metrics,
+                             flight=eng.flight,
+                             context=dataclasses.asdict(eng.config))
+            ctl = FleetController(
+                eng, dict(FAST_CTL, knobs=["flush"])).attach(mon)
+            t = 0.0
+            eng.metrics.gauge("queue_depth_live").set(50)   # 0.78 sat
+            _observe(mon, eng, t)
+            assert eng.config.flush_timeout_s == 0.002
+            assert eng.config.max_batch == 64
+            eng.metrics.gauge("queue_depth_live").set(0)
+            for i in range(3):
+                _observe(mon, eng, t + 0.1 * (i + 1))
+            assert eng.config.flush_timeout_s == 0.001
+            assert eng.config.max_batch == 32
+            acts = eng.flight.events("actuation")
+            assert [a["action"] for a in acts] == ["widen", "restore"]
+            assert all(a["signal"] for a in acts)
+            assert ctl.state()["knobs"]["flush"]["level"] == 0
+
+    def test_every_actuation_has_a_nonnull_signal(self):
+        """Randomized signal schedule: bounded actuations per window,
+        every actuation flight-evented with a non-null triggering
+        signal."""
+        rng = np.random.default_rng(11)
+        with MultiTenantEngine(
+                ServingConfig(queue_size=64, flush_timeout_s=0.001),
+                TenancyConfig()) as eng:
+            mon = SloMonitor(SAT_SPEC, registry=eng.metrics,
+                             flight=eng.flight,
+                             context=dataclasses.asdict(eng.config))
+            FleetController(
+                eng, {"cooldown_s": 0.05, "up_ticks": 2,
+                      "down_ticks": 3}).attach(mon)
+            t = 0.0
+            for _ in range(300):
+                eng.metrics.gauge("queue_depth_live").set(
+                    int(rng.integers(0, 64)))
+                _observe(mon, eng, t)
+                t += 0.01
+            acts = eng.flight.events("actuation")
+            assert all(isinstance(a["signal"], dict) and a["signal"]
+                       for a in acts)
+            # 3 simulated seconds / 0.05 cooldown -> per-knob bound
+            per_knob = {}
+            for a in acts:
+                per_knob[a["knob"]] = per_knob.get(a["knob"], 0) + 1
+            assert all(n <= 3 / 0.05 + 1 for n in per_knob.values()), \
+                per_knob
+            assert mon.actuator_errors == 0
+
+    def test_slope_promotion_fires_before_threshold(self):
+        with MultiTenantEngine(
+                ServingConfig(flush_timeout_s=0.001),
+                TenancyConfig(whale_threshold=2000)) as eng:
+            ctl = FleetController(
+                eng, dict(FAST_CTL, knobs=["promote"],
+                          promote_lookahead_s=2.0))
+            rng = np.random.default_rng(2)
+            s = rng.standard_normal(300)
+            l = rng.random(300) < 0.5
+            eng.insert("hot", s, l).result(10.0)
+            eng.flush()
+            sig = lambda t: {"ts_mono": t,  # noqa: E731
+                             "metrics": eng.metrics.snapshot(),
+                             "transitions": [], "objectives": {}}
+            ctl.on_signals(sig(0.0))
+            s2 = rng.standard_normal(400)
+            l2 = rng.random(400) < 0.5
+            eng.insert("hot", s2, l2).result(10.0)
+            eng.flush()
+            # rate = 400 events / 0.1 s -> projected 700 + 8000 > 2000
+            ctl.on_signals(sig(0.1))
+            assert eng.fleet.is_whale("hot")
+            acts = eng.flight.events("actuation")
+            assert any(a["action"] == "promote_whale"
+                       and a["signal"]["tenant"] == "hot"
+                       and a["signal"]["value"] > 0 for a in acts)
+            # promotion is statistically invisible [PR 9 contract]
+            oracle = ExactAucIndex(engine="jax")
+            oracle.insert_batch(np.concatenate([s, s2]),
+                                np.concatenate([l, l2]))
+            assert eng.fleet.wins2("hot") == oracle._wins2
+
+    def test_weights_boost_and_restore(self):
+        with MultiTenantEngine(
+                ServingConfig(flush_timeout_s=0.001),
+                TenancyConfig(weight=2)) as eng:
+            ctl = FleetController(
+                eng, dict(FAST_CTL, knobs=["weights"], slow_factor=2.0))
+            m = eng.metrics
+            for i, tid in enumerate(["a", "b", "c", "d", "slowpoke"]):
+                h = m.histogram("insert_latency_s",
+                                labels={"tenant": tid})
+                v = 0.5 if tid == "slowpoke" else 0.01
+                for _ in range(10):
+                    h.observe(v)
+            sig = lambda t: {"ts_mono": t,  # noqa: E731
+                             "metrics": m.snapshot(),
+                             "transitions": [], "objectives": {}}
+            ctl.on_signals(sig(0.0))
+            assert eng._tenant_weights.get("slowpoke") == 2 * 4
+            # calm: slowpoke's p99 falls back under the factor once
+            # fast samples dominate its retained window -> restore
+            h = m.histogram("insert_latency_s",
+                            labels={"tenant": "slowpoke"})
+            for _ in range(3000):
+                h.observe(0.01)
+            for t in range(1, 4):
+                ctl.on_signals(sig(0.1 * t))
+            assert "slowpoke" not in eng._tenant_weights
+            acts = eng.flight.events("actuation")
+            assert [a["action"] for a in acts] == ["boost", "restore"]
+
+
+# --------------------------------------------------------------------- #
+# scenario suite [ISSUE 11 acceptance]                                   #
+# --------------------------------------------------------------------- #
+
+def _run_flash_crowd(controlled, tenants=16, rounds=6, burst=80,
+                     shards=None, chaos=None, whale="t0",
+                     mesh_knob=False):
+    """One flash-crowd run: per round, a large innocent insert wedges
+    the batcher while ``whale`` bursts ``burst`` single-event inserts;
+    the SLO monitor is pumped every 20 submits. Returns (slo_report,
+    per-tenant wins2 of the fleet, independent-oracle wins2 over the
+    ADMITTED events, metrics snapshot, engine flight events)."""
+    rng = np.random.default_rng(17)
+    cfg = ServingConfig(queue_size=64, policy="reject",
+                        flush_timeout_s=0.001, max_batch=32,
+                        mesh_shards=shards)
+    knobs = ["shed", "flush"] + (["mesh"] if mesh_knob else [])
+    injector = None
+    if chaos is not None:
+        from tuplewise_tpu.testing.chaos import FaultInjector
+
+        injector = FaultInjector.from_spec(chaos)
+    # admitted events per tenant, oracled AFTER the run (a jitted
+    # index insert per submit would distort the burst timing the
+    # scenario depends on)
+    admitted = {}
+
+    def feed_single(tid, s, l):
+        admitted.setdefault(tid, []).append((s, l))
+
+    with MultiTenantEngine(cfg, TenancyConfig(
+            max_tenants=tenants + 8, tenant_quota=4096),
+            chaos=injector) as eng:
+        mon = SloMonitor(SAT_SPEC, registry=eng.metrics,
+                         flight=eng.flight,
+                         context=dataclasses.asdict(cfg))
+        if controlled:
+            FleetController(
+                eng, dict(FAST_CTL, knobs=knobs,
+                          mesh_up_ticks=1, mesh_down_ticks=64,
+                          throttle_s=0.05)).attach(mon)
+        for r in range(rounds):
+            # innocents: small batches, resolved in bounded windows
+            # (in-quota, polite — they never outrun the queue)
+            futs = []
+
+            def _drain():
+                for tid_, s_, l_, f_ in futs:
+                    f_.result(30.0)
+                    feed_single(tid_, s_, l_)
+                futs.clear()
+
+            for k in range(1, tenants):
+                s = rng.standard_normal(8)
+                l = rng.random(8) < 0.5
+                futs.append((f"t{k}", s, l,
+                             eng.insert(f"t{k}", s, l)))
+                if len(futs) >= 32:
+                    _drain()
+            _drain()
+            # the wedge: one big innocent insert occupies the batcher
+            ws = rng.standard_normal(30_000)
+            wl = rng.random(30_000) < 0.5
+            wedge_fut = eng.insert(f"t{tenants - 1}", ws, wl)
+            feed_single(f"t{tenants - 1}", ws, wl)
+            # the flash crowd: whale bursts while the batcher is busy
+            for i in range(burst):
+                s = rng.standard_normal(1)
+                l = rng.random(1) < 0.5
+                try:
+                    eng.insert(whale, s, l)
+                    feed_single(whale, s, l)
+                except TenantThrottledError:
+                    pass    # controlled shed: excluded from oracle too
+                except BackpressureError:
+                    pass    # the uncontrolled twin's hard rejects
+                # every 10 submits: the queue must not be able to jump
+                # from below the warn band (0.7*0.8*64 = 36) past the
+                # breach line (0.8*64 = 51) between two observations
+                if (i + 1) % 10 == 0:
+                    _observe(mon, eng, time.perf_counter())
+            wedge_fut.result(60.0)
+            eng.flush()
+            _observe(mon, eng, time.perf_counter())
+            time.sleep(0.06)    # let throttles expire between rounds
+        eng.flush()
+        slo = mon.report()
+        m = eng.metrics.snapshot()
+        fleet_wins = {t: eng.fleet.wins2(t)
+                      for t in eng.fleet.tenants()}
+        flight = eng.flight.events()
+    oracle_wins = {}
+    for tid, batches in admitted.items():
+        idx = ExactAucIndex(engine="jax")
+        idx.insert_batch(np.concatenate([s for s, _ in batches]),
+                         np.concatenate([l for _, l in batches]))
+        oracle_wins[tid] = idx._wins2
+    return slo, fleet_wins, oracle_wins, m, flight
+
+
+class TestScenarios:
+    def test_flash_crowd_controlled_vs_uncontrolled(self):
+        """[acceptance] the controlled fleet keeps the SLO verdict
+        healthy and sheds ONLY the flooding tenant (typed, zero hard
+        rejects); the uncontrolled twin breaches. Per-tenant wins2
+        stays bit-identical to independents through every actuation."""
+        slo, fleet_wins, oracle_wins, m, flight = _run_flash_crowd(
+            controlled=True)
+        assert slo["healthy"], slo
+        assert m["rejected_total"]["value"] == 0
+        assert m["tenant_rejected_total"]["value"] == 0
+        assert m["tenant_throttled_total"]["value"] > 0
+        # shed/throttle affects admission, never applied state
+        assert fleet_wins == oracle_wins
+        acts = [e for e in flight if e["kind"] == "actuation"]
+        assert acts and all(a["signal"] for a in acts)
+        throttled = [a for a in acts if a["action"] == "throttle"]
+        assert throttled
+        assert all(set(a["tenants"]) == {"t0"} for a in throttled)
+
+        slo_u, fleet_u, oracle_u, m_u, _ = _run_flash_crowd(
+            controlled=False)
+        assert not slo_u["healthy"], "uncontrolled twin must breach"
+        assert fleet_u == oracle_u   # parity holds even while breaching
+
+    def test_tenant_ramp_controlled_vs_uncontrolled(self):
+        """[acceptance] onboarding ramp: each arriving tenant bursts;
+        the controller throttles the arrival spike so the shared queue
+        never saturates and nobody gets a hard reject."""
+        for controlled in (True, False):
+            rng = np.random.default_rng(23)
+            cfg = ServingConfig(queue_size=64, policy="reject",
+                                flush_timeout_s=0.001, max_batch=32)
+            admitted = {}
+            with MultiTenantEngine(cfg, TenancyConfig(
+                    max_tenants=128, tenant_quota=4096)) as eng:
+                mon = SloMonitor(SAT_SPEC, registry=eng.metrics,
+                                 flight=eng.flight,
+                                 context=dataclasses.asdict(cfg))
+                if controlled:
+                    FleetController(
+                        eng, dict(FAST_CTL, knobs=["shed", "flush"],
+                                  throttle_s=0.05)).attach(mon)
+                for arrival in range(8):
+                    ws = rng.standard_normal(30_000)
+                    wl = rng.random(30_000) < 0.5
+                    wedge = eng.insert("base", ws, wl)
+                    admitted.setdefault("base", []).append((ws, wl))
+                    tid = f"new{arrival}"
+                    for i in range(60):
+                        s = rng.standard_normal(1)
+                        l = rng.random(1) < 0.5
+                        try:
+                            eng.insert(tid, s, l)
+                            admitted.setdefault(tid, []).append((s, l))
+                        except TenantThrottledError:
+                            pass
+                        except BackpressureError:
+                            pass    # uncontrolled twin's hard rejects
+                        if (i + 1) % 10 == 0:
+                            _observe(mon, eng, time.perf_counter())
+                    wedge.result(60.0)
+                    eng.flush()
+                    _observe(mon, eng, time.perf_counter())
+                    time.sleep(0.06)
+                slo = mon.report()
+                m = eng.metrics.snapshot()
+                wins = {t: eng.fleet.wins2(t)
+                        for t in eng.fleet.tenants()}
+            oracle = {}
+            for tid, batches in admitted.items():
+                idx = ExactAucIndex(engine="jax")
+                idx.insert_batch(
+                    np.concatenate([s for s, _ in batches]),
+                    np.concatenate([l for _, l in batches]))
+                oracle[tid] = idx._wins2
+            assert wins == oracle
+            if controlled:
+                assert slo["healthy"], slo
+                assert m["rejected_total"]["value"] == 0
+                assert m["tenant_throttled_total"]["value"] > 0
+            else:
+                assert not slo["healthy"], \
+                    "uncontrolled ramp must breach"
+
+    def test_device_loss_heals_then_controller_regrows(self):
+        """[acceptance] device loss at S=2: the fleet heals (shrinks)
+        through the PR 3/8 machinery, then the controller grows the
+        mesh back under pressure — results bit-identical throughout."""
+        chaos = {"faults": [{"point": "sharded_count", "on_call": 3,
+                             "action": "error", "dropped": [1]}]}
+        slo, fleet_wins, oracle_wins, m, flight = _run_flash_crowd(
+            controlled=True, tenants=8, rounds=4, shards=2,
+            chaos=chaos, mesh_knob=True)
+        assert slo["healthy"], slo
+        assert fleet_wins == oracle_wins
+        kinds = [e["kind"] for e in flight]
+        assert "heal" in kinds          # the loss was healed
+        grows = [e for e in flight if e["kind"] == "actuation"
+                 and e["knob"] == "mesh" and e["action"] == "grow"]
+        assert grows and all(a["signal"] for a in grows)
+        assert m["mesh_width"]["value"] > 1
+
+    @pytest.mark.slow
+    def test_flash_crowd_t256(self):
+        """[acceptance, slow] the headline scale: T=256 over S=2."""
+        slo, fleet_wins, oracle_wins, m, flight = _run_flash_crowd(
+            controlled=True, tenants=256, rounds=3, shards=2)
+        assert slo["healthy"], slo
+        assert m["rejected_total"]["value"] == 0
+        assert fleet_wins == oracle_wins
+        slo_u, fleet_u, oracle_u, _, _ = _run_flash_crowd(
+            controlled=False, tenants=256, rounds=3, shards=2)
+        assert not slo_u["healthy"]
+        assert fleet_u == oracle_u
+
+
+# --------------------------------------------------------------------- #
+# doctor attribution [ISSUE 11 satellite]                                #
+# --------------------------------------------------------------------- #
+
+class TestDoctorActuations:
+    def _artifacts(self, tmp_path, events, rows_after=True):
+        from tuplewise_tpu.obs.flight import FlightRecorder
+
+        fr = FlightRecorder()
+        for kind, fields in events:
+            fr.record(kind, **fields)
+        fpath = str(tmp_path / "flight.jsonl")
+        fr.dump_to(fpath)
+        mpath = str(tmp_path / "metrics.jsonl")
+        ts = time.perf_counter() + (100.0 if rows_after else -100.0)
+        with open(mpath, "w") as f:
+            for i in range(2):
+                f.write(json.dumps({
+                    "seq": i + 1, "ts_wall": time.time(),
+                    "ts_mono": ts + i, "metrics": {}}) + "\n")
+        return mpath, fpath
+
+    def test_attributed_actuations_keep_verdict(self, tmp_path):
+        from tuplewise_tpu.obs.doctor import diagnose
+
+        mp, fp = self._artifacts(tmp_path, [
+            ("actuation", dict(knob="shed", action="throttle",
+                               signal={"objective": "queue_sat",
+                                       "value": 0.7,
+                                       "threshold": 0.8})),
+            ("actuation", dict(knob="flush", action="widen",
+                               signal={"objective": "queue_sat",
+                                       "value": 0.75,
+                                       "threshold": 0.8})),
+        ])
+        rep = diagnose(metrics_path=mp, flight_path=fp)
+        assert rep["actuations"]["total"] == 2
+        assert rep["actuations"]["attributed"] == 2
+        assert rep["verdict"] == "healthy"
+        assert rep["verdict_line"]["actuations_attributed"] == 2
+
+    def test_missing_signal_downgrades(self, tmp_path):
+        from tuplewise_tpu.obs.doctor import diagnose
+
+        mp, fp = self._artifacts(tmp_path, [
+            ("actuation", dict(knob="shed", action="throttle",
+                               signal=None)),
+        ])
+        rep = diagnose(metrics_path=mp, flight_path=fp)
+        assert rep["actuations"]["unattributed"] == 1
+        assert rep["verdict"].startswith("degraded")
+        assert "unattributed_actuation" in rep["verdict"]
+        assert not rep["verdict_line"]["healthy"]
+
+    def test_missing_effect_window_downgrades(self, tmp_path):
+        from tuplewise_tpu.obs.doctor import diagnose
+
+        mp, fp = self._artifacts(tmp_path, [
+            ("actuation", dict(knob="mesh", action="grow",
+                               signal={"objective": "x", "value": 1,
+                                       "threshold": 2})),
+        ], rows_after=False)
+        rep = diagnose(metrics_path=mp, flight_path=fp)
+        assert rep["actuations"]["unattributed"] == 1
+        assert "unattributed_actuation" in rep["verdict"]
+
+    def test_no_controller_no_actuation_block(self, tmp_path):
+        from tuplewise_tpu.obs.doctor import diagnose
+
+        mp, fp = self._artifacts(tmp_path, [
+            ("compaction", dict(tier="minor")),
+        ])
+        rep = diagnose(metrics_path=mp, flight_path=fp)
+        assert "actuations" not in rep
+        assert rep["verdict_line"]["actuations"] == 0
+
+
+# --------------------------------------------------------------------- #
+# replay integration                                                     #
+# --------------------------------------------------------------------- #
+
+class TestReplayIntegration:
+    def test_replay_fleet_with_controller(self):
+        from tuplewise_tpu.serving import make_tenant_stream, replay_fleet
+
+        scores, labels, tenants = make_tenant_stream(
+            1500, 8, skew=1.2, seed=3)
+        rec = replay_fleet(
+            scores, labels, tenants, chunk=8, max_inflight=64,
+            config=ServingConfig(flush_timeout_s=0.001),
+            tenancy=TenancyConfig(max_tenants=16, tenant_quota=4096),
+            slo_spec=SAT_SPEC,
+            controller_spec={"knobs": ["shed", "flush"]})
+        assert "controller" in rec
+        assert rec["controller"]["enabled"]
+        assert "events_tenant_throttled" in rec
+        assert "tenant_throttled_total" in rec["admission"]
+        assert rec["report"]["controller"]["actuations_total"] >= 0
+        # unthrottled run: parity guardrail still applies
+        assert rec["tenant_auc_max_abs_err"] < 1e-6
+
+    def test_controller_needs_slo(self):
+        from tuplewise_tpu.serving import make_tenant_stream, replay_fleet
+
+        scores, labels, tenants = make_tenant_stream(50, 2, seed=0)
+        with pytest.raises(ValueError, match="needs slo_spec"):
+            replay_fleet(scores, labels, tenants,
+                         controller_spec={})
+
+
+class TestActuatorHook:
+    def test_actuator_receives_objective_state(self):
+        seen = []
+        mon = SloMonitor(SAT_SPEC, context={"queue_size": 100},
+                         actuators=[seen.append])
+        mon.observe({"queue_depth_live": {"value": 90}}, 1.0)
+        assert len(seen) == 1
+        sig = seen[0]
+        assert sig["ts_mono"] == 1.0
+        assert sig["objectives"]["queue_sat"]["breached_now"]
+        assert sig["objectives"]["queue_sat"]["value"] == 0.9
+
+    def test_actuator_errors_are_swallowed_and_counted(self):
+        def boom(sig):
+            raise RuntimeError("actuator bug")
+
+        mon = SloMonitor(SAT_SPEC, context={"queue_size": 100})
+        mon.add_actuator(boom)
+        mon.observe({}, 1.0)    # must not raise
+        assert mon.actuator_errors == 1
+        assert "actuator bug" in mon.last_actuator_error

@@ -172,13 +172,14 @@ def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
     from tuplewise_tpu.serving import (
         BackpressureError, DeadlineExceededError, EngineClosedError,
         MicroBatchEngine, MultiTenantEngine, PoisonEventError,
-        TenantRejectedError,
+        TenantRejectedError, TenantThrottledError,
     )
     from tuplewise_tpu.utils.profiling import trace as _jax_trace
 
     tracer = Tracer() if obs is not None and obs.trace_out else None
     flusher = None
     slo_monitor = None
+    controller = None
     if tenancy is not None:
         engine_cm = MultiTenantEngine(cfg, tenancy, chaos=chaos,
                                       tracer=tracer)
@@ -193,6 +194,17 @@ def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
             slo_monitor = SloMonitor(
                 obs.slo_spec, registry=eng.metrics, flight=eng.flight,
                 context=dataclasses.asdict(cfg))
+        if obs is not None and getattr(obs, "controller_spec", None):
+            # close the loop [ISSUE 11]: the controller actuates on
+            # the very signals the SLO monitor judges
+            if slo_monitor is None:
+                raise SystemExit(
+                    "--controller-spec needs --slo-spec: the "
+                    "controller rides the SLO monitor's signals")
+            from tuplewise_tpu.serving.control import FleetController
+
+            controller = FleetController(
+                eng, obs.controller_spec).attach(slo_monitor)
         if obs is not None and (obs.metrics_out
                                 or slo_monitor is not None):
             every = obs.metrics_every
@@ -262,6 +274,13 @@ def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
                                 "state": snap.get("index")}
                     else:
                         resp = {"ok": False, "error": f"unknown op {op!r}"}
+                except TenantThrottledError as e:
+                    # control-plane shed [ISSUE 11]: typed, with the
+                    # retry hint in the wire protocol — a client can
+                    # back off instead of hammering a defending fleet
+                    resp = {"ok": False, "tenant": e.tenant,
+                            "retry_after_s": e.retry_after_s,
+                            "error": f"tenant_throttled: {e}"}
                 except TenantRejectedError as e:
                     resp = {"ok": False, "tenant": e.tenant,
                             "error": f"tenant_rejected: {e}"}
@@ -296,6 +315,8 @@ def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
     # by the SAME report builder replay records use [ISSUE 6 satellite]
     summary = service_report(m, chaos=chaos, flight=flight,
                              slo=slo_monitor)
+    if controller is not None:
+        summary["controller"] = controller.state()
     print(json.dumps({"exit_summary": summary}), file=sys.stderr)
     print(json.dumps({"final_stats": m}), file=sys.stderr)
     return 0
@@ -495,6 +516,21 @@ def main(argv=None) -> int:
                             "replay record. Label wildcards "
                             "(insert_latency_s{tenant=*}) judge each "
                             "tenant of a fleet separately [ISSUE 8]")
+        p.add_argument("--controller-spec", type=str, default=None,
+                       help="SLO-driven control plane [ISSUE 11]: a "
+                            "serving.control.ControllerConfig spec "
+                            "(JSON inline, @file, *.json, or '{}' for "
+                            "defaults) — a FleetController rides the "
+                            "--slo-spec monitor's signals and closes "
+                            "the loop: typed per-tenant throttling "
+                            "before a breach (TenantThrottledError + "
+                            "retry_after_s), flush-window/micro-batch "
+                            "widening, DRR weight rebalance, mesh "
+                            "grow/shrink, slope-based whale promotion."
+                            " Every actuation is hysteretic, rate-"
+                            "limited, budgeted, reversible, and flight-"
+                            "evented with its triggering signal. "
+                            "Requires --slo-spec")
         # multi-tenant fleet [ISSUE 8]
         p.add_argument("--tenants", type=int, default=1,
                        help="replay: synthetic tenants in the generated "
@@ -659,7 +695,8 @@ def main(argv=None) -> int:
                                  metrics_out=args.metrics_out,
                                  metrics_every_s=args.metrics_every,
                                  flight_out=args.flight_out,
-                                 slo_spec=args.slo_spec),
+                                 slo_spec=args.slo_spec,
+                                 controller_spec=args.controller_spec),
                     args.out,
                 )
                 return 0
@@ -677,7 +714,8 @@ def main(argv=None) -> int:
                        metrics_every_s=args.metrics_every,
                        profile_dir=args.profile_dir,
                        flight_out=args.flight_out,
-                       slo_spec=args.slo_spec),
+                       slo_spec=args.slo_spec,
+                       controller_spec=args.controller_spec),
                 args.out,
             )
             return 0

@@ -290,6 +290,57 @@ def correlate_faults(flight_events: List[dict], metrics_rows: List[dict],
     return faults
 
 
+def correlate_actuations(flight_events: List[dict],
+                         metrics_rows: List[dict]) -> Optional[dict]:
+    """Control-plane attribution [ISSUE 11]: one entry per
+    ``actuation`` flight event, each judged on the cause→action→effect
+    chain the controller promises — a non-null triggering ``signal``
+    (the cause) AND at least one metrics snapshot observed after the
+    actuation (the effect window: a run that died before the
+    post-actuation state was ever recorded cannot claim the actuation
+    worked). ``attributed=False`` entries downgrade the verdict to
+    ``degraded:unattributed_actuation`` — a controller that cannot
+    explain WHY it turned a knob is itself a fault. None when the run
+    had no controller (no actuation events)."""
+    acts = [e for e in flight_events if e["kind"] == "actuation"]
+    if not acts:
+        return None
+    mono_ts = sorted(r["ts_mono"] for r in metrics_rows
+                     if "ts_mono" in r)
+    # grace = one flusher cadence (median inter-row gap): the FINAL
+    # flush runs its observers after writing its row, so an actuation
+    # triggered by the last snapshot of a clean shutdown has its
+    # evidence in that row, not after it. A run that died leaves its
+    # post-crash actuations well outside one cadence.
+    gaps = [b - a for a, b in zip(mono_ts, mono_ts[1:])]
+    grace = sorted(gaps)[len(gaps) // 2] if gaps else 1.0
+    entries = []
+    by_knob: dict = defaultdict(int)
+    for e in acts:
+        sig = e.get("signal")
+        has_signal = isinstance(sig, dict) and bool(sig) \
+            and any(v is not None for v in sig.values())
+        effect = bool(mono_ts) and (
+            mono_ts[-1] >= e["t_mono"]
+            or e["t_mono"] - mono_ts[-1] <= grace)
+        entries.append({
+            "seq": e["seq"], "t_wall": e.get("t_wall"),
+            "knob": e.get("knob"), "action": e.get("action"),
+            "signal": sig, "has_signal": has_signal,
+            "effect_window": effect,
+            "attributed": has_signal and effect,
+        })
+        by_knob[e.get("knob")] += 1
+    return {
+        "total": len(entries),
+        "attributed": sum(1 for a in entries if a["attributed"]),
+        "unattributed": sum(1 for a in entries
+                            if not a["attributed"]),
+        "by_knob": dict(by_knob),
+        "events": entries,
+    }
+
+
 # --------------------------------------------------------------------- #
 # diagnosis                                                              #
 # --------------------------------------------------------------------- #
@@ -386,6 +437,13 @@ def diagnose(metrics_path: Optional[str] = None,
     # fault -> breach correlation
     faults = correlate_faults(flight_events, metrics_rows, spans)
     report["faults"] = faults
+
+    # control-plane attribution [ISSUE 11]: every actuation tied to
+    # its triggering signal + an observed effect window (None and
+    # omitted when the run had no controller)
+    actuations = correlate_actuations(flight_events, metrics_rows)
+    if actuations is not None:
+        report["actuations"] = actuations
     kinds: dict = {}
     for e in flight_events:
         kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
@@ -419,6 +477,11 @@ def _verdict(report: dict, kinds: dict) -> str:
     unresolved = [f for f in report["faults"] if not f["resolved"]]
     if unresolved:
         degraded.append(f"{len(unresolved)}_unresolved_faults")
+    # an actuation without a triggering signal or an observed effect
+    # window means the control plane acted unexplained [ISSUE 11]
+    acts = report.get("actuations")
+    if acts is not None and acts["unattributed"]:
+        degraded.append("unattributed_actuation")
     if degraded:
         return "degraded:" + ",".join(degraded)
     # failures that DID happen and were recovered from
@@ -433,6 +496,7 @@ def verdict_line(report: dict) -> dict:
     CLI; ``tail -n 1`` is the whole CI integration)."""
     v = report["verdict"]
     slo = report.get("slo") or {}
+    acts = report.get("actuations") or {}
     return {
         "doctor_verdict": v.split(":", 1)[0],
         "detail": v.split(":", 1)[1] if ":" in v else None,
@@ -444,6 +508,8 @@ def verdict_line(report: dict) -> dict:
             o["breaches_total"]
             for o in slo.get("objectives", {}).values()),
         "drift_alerts": report["health"]["drift_alerts"],
+        "actuations": acts.get("total", 0),
+        "actuations_attributed": acts.get("attributed", 0),
     }
 
 

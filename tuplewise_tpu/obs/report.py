@@ -146,6 +146,20 @@ def service_report(metrics: dict, chaos=None,
             "tenant_metric_collapsed": _v(metrics,
                                           "tenant_metric_collapsed"),
         }
+    # control-plane block [ISSUE 11]: only when a FleetController ran
+    # (controller-off reports keep their exact pre-controller key set)
+    if "controller_actuations_total" in metrics:
+        report["controller"] = {
+            "actuations_total": _v(metrics,
+                                   "controller_actuations_total"),
+            "reverts_total": _v(metrics, "controller_reverts_total"),
+            "tenant_throttled_total": _v(metrics,
+                                         "tenant_throttled_total"),
+            "throttled_now": _v(metrics, "controller_throttled_tenants"),
+            "flush_scale": _v(metrics, "controller_flush_scale"),
+            "max_batch": _v(metrics, "controller_max_batch"),
+            "mesh_level": _v(metrics, "controller_mesh_level"),
+        }
     if chaos is not None:
         report["chaos"] = chaos.snapshot()
     if flight is not None:

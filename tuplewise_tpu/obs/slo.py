@@ -264,10 +264,23 @@ class SloMonitor:
     Wire ``observe_row`` as a ``MetricsFlusher`` observer for live
     evaluation, or call :func:`evaluate_history` on a finished
     metrics.jsonl.
+
+    **Actuator hook** [ISSUE 11]: the monitor *judges*; an actuator
+    *acts*. ``add_actuator(fn)`` registers a callable invoked after
+    every evaluation with one signal bundle — the snapshot, the new
+    breach transitions, and every objective's current state (value,
+    threshold, burn, ``breached_now``) — the sibling of the
+    ``MetricsFlusher`` observer hook this monitor itself rides. The
+    serving control plane (``serving.control.FleetController``)
+    attaches here, so "close the loop" costs no second timer thread
+    and the controller sees exactly the snapshots the SLO verdicts are
+    judged on. Actuator exceptions are swallowed and counted
+    (``actuator_errors`` / ``last_actuator_error``) — an actuator must
+    never take down the evaluation that drives it.
     """
 
     def __init__(self, spec, registry=None, flight=None,
-                 context: Optional[dict] = None):
+                 context: Optional[dict] = None, actuators=()):
         self.spec = SloSpec.from_spec(spec)
         self.registry = registry
         self.flight = flight
@@ -277,6 +290,16 @@ class SloMonitor:
         # a "before" edge)
         self._ring: List[Tuple[float, dict]] = []
         self.evaluations = 0
+        self.actuators = list(actuators)
+        self.actuator_errors = 0
+        self.last_actuator_error: Optional[str] = None
+
+    def add_actuator(self, fn) -> None:
+        """Register an actuator callable; it receives one dict per
+        evaluation: ``{"ts_mono", "metrics", "transitions",
+        "objectives": {name: {..last detail.., "type", "breached_now",
+        "breaches_total"}}}``."""
+        self.actuators.append(fn)
 
     # ------------------------------------------------------------------ #
     def observe_row(self, row: dict) -> None:
@@ -308,6 +331,23 @@ class SloMonitor:
                     self.flight.record("slo_breach", **ev)
             o.breached_now = breached
             self._export(o, detail)
+        if self.actuators:
+            sig = {
+                "ts_mono": ts_mono,
+                "metrics": metrics,
+                "transitions": transitions,
+                "objectives": {
+                    o.name: dict(o.last, type=o.type,
+                                 breached_now=o.breached_now,
+                                 breaches_total=o.breaches_total)
+                    for o in self.spec.objectives},
+            }
+            for fn in self.actuators:
+                try:
+                    fn(sig)
+                except Exception as e:  # noqa: BLE001 — see class doc
+                    self.actuator_errors += 1
+                    self.last_actuator_error = repr(e)
         return transitions
 
     # ------------------------------------------------------------------ #

@@ -213,6 +213,33 @@ class MeshHealer:
         self.mesh = make_mesh(len(new_devices), devices=new_devices)
         return True
 
+    def resize(self, width: int) -> bool:
+        """Deliberate mesh re-width [ISSUE 11] — a control-plane
+        actuation, not a recovery: rebuild the mesh at ``width``
+        workers from the surviving device pool (growth uses the spare
+        devices the pool holds beyond the current mesh; shrink keeps
+        the pool's prefix, so a later grow restores the same devices).
+        Returns True when the mesh changed; the CALLER re-places its
+        device state, exactly as after ``heal``. Refused (False) for
+        ``fixed_width`` policies (the width is part of the experiment's
+        semantics there), mesh-less healers, out-of-pool widths, and
+        no-op widths. Counts as a ``reshard_events`` and records a
+        ``mesh_resize`` flight event."""
+        from tuplewise_tpu.parallel.mesh import make_mesh
+
+        if self.mesh is None or self.fixed_width is not None:
+            return False
+        width = int(width)
+        old = self.n_workers
+        if width < 1 or width > len(self._pool) or width == old:
+            return False
+        self.mesh = make_mesh(width, devices=self._pool[:width])
+        self._c_reshard.inc()
+        if self.flight is not None:
+            self.flight.record("mesh_resize", from_width=old,
+                               to_width=width)
+        return True
+
     def heal(self, attempt: int,
              on_heal: Optional[Callable] = None) -> bool:
         """One recovery round: probe/reshard, let the caller re-place

@@ -56,8 +56,26 @@ traffic is a *stream* of scored events, so this package adds:
                                  off-batcher tenant compaction, and a
                                  ``tenant_metric_cap`` cardinality
                                  bound.
+* ``control``                  — the SLO-driven control plane
+                                 [ISSUE 11]: ``FleetController`` rides
+                                 the SLO monitor's actuator hook and
+                                 defends the fleet's SLOs before they
+                                 breach — typed per-tenant throttling
+                                 (``TenantThrottledError`` +
+                                 retry-after hint), flush-window /
+                                 micro-batch widening, DRR weight
+                                 rebalance, mesh grow/shrink, and
+                                 slope-based whale promotion; every
+                                 actuation hysteretic, rate-limited,
+                                 budgeted, reversible, and
+                                 flight-evented with its triggering
+                                 signal for ``doctor`` attribution.
 """
 
+from tuplewise_tpu.serving.control import (
+    ControllerConfig,
+    FleetController,
+)
 from tuplewise_tpu.serving.engine import (
     BackpressureError,
     DeadlineExceededError,
@@ -79,13 +97,16 @@ from tuplewise_tpu.serving.tenancy import (
     TenancyConfig,
     TenantFleetIndex,
     TenantRejectedError,
+    TenantThrottledError,
 )
 
 __all__ = [
     "BackpressureError",
+    "ControllerConfig",
     "DeadlineExceededError",
     "EngineClosedError",
     "ExactAucIndex",
+    "FleetController",
     "MicroBatchEngine",
     "MultiTenantEngine",
     "PoisonEventError",
@@ -94,6 +115,7 @@ __all__ = [
     "TenancyConfig",
     "TenantFleetIndex",
     "TenantRejectedError",
+    "TenantThrottledError",
     "make_stream",
     "make_tenant_stream",
     "replay",

@@ -325,6 +325,18 @@ class MicroBatchEngine:
         self._worker = threading.Thread(
             target=self._supervise, name="tuplewise-batcher", daemon=True)
         self._worker.start()
+        # deadline reaper [ISSUE 11 bugfix]: dispatch-time expiry
+        # (PR 3) only runs when the batcher dispatches — a wedged
+        # batcher (stuck apply, crash-restart loop) or an idle one lets
+        # stale "block"-policy requests rot past their deadline with
+        # their producers still blocked. A timer scans the queue and
+        # fails over-deadline requests typed, whoever gets there first.
+        self._reaper = None
+        if config.deadline_s is not None:
+            self._reaper = threading.Thread(
+                target=self._reap_expired, name="tuplewise-reaper",
+                daemon=True)
+            self._reaper.start()
 
     # ------------------------------------------------------------------ #
     # request side                                                       #
@@ -532,7 +544,8 @@ class MicroBatchEngine:
                 else:
                     snap = self.stats()
                     for r in run:
-                        r.future.set_result(snap)
+                        if not r.future.done():
+                            r.future.set_result(snap)
             except Exception as e:      # fail the run, keep serving
                 for r in run:
                     if not r.future.done():
@@ -548,32 +561,63 @@ class MicroBatchEngine:
                     r.span = None
         self._g_inflight.set(self._q.qsize())
 
+    def _expire_request(self, r: _Request, now: float) -> bool:
+        """Fail ONE over-deadline request typed; returns True when this
+        caller won the resolution. Idempotent across the dispatch-time
+        check and the reaper timer — ``set_exception`` on an
+        already-done future loses the race, and only the winner counts
+        the expiry [ISSUE 11 bugfix]."""
+        try:
+            r.future.set_exception(DeadlineExceededError(
+                f"request expired after {now - r.t_enqueue:.3f}s "
+                f"in queue (deadline_s={self.config.deadline_s})"))
+        except Exception:   # noqa: BLE001 — already resolved elsewhere
+            return False
+        self._c_deadline.inc()
+        self.flight.record(
+            "deadline_expired", kind_req=r.kind,
+            waited_s=now - r.t_enqueue,
+            trace_id=(r.span.trace_id if r.span is not None else None))
+        if self.tracer is not None and r.span is not None:
+            self.tracer.finish(r.span, now)
+            r.span = None
+        return True
+
     def _expire(self, batch: List[_Request]) -> List[_Request]:
         """Deadline enforcement at dispatch [ISSUE 3]: a request that
         aged past ``deadline_s`` in the queue fails typed — serving it
         would return a stale answer late AND delay everything behind
-        it."""
+        it. Requests the reaper already failed are dropped silently."""
         now = time.perf_counter()
         live: List[_Request] = []
         for r in batch:
+            if r.future.done():
+                continue    # reaper got it while it sat in the queue
             if now - r.t_enqueue > self.config.deadline_s:
-                self._c_deadline.inc()
-                self.flight.record(
-                    "deadline_expired", kind_req=r.kind,
-                    waited_s=now - r.t_enqueue,
-                    trace_id=(r.span.trace_id if r.span is not None
-                              else None))
-                if not r.future.done():
-                    r.future.set_exception(DeadlineExceededError(
-                        f"request expired after {now - r.t_enqueue:.3f}s "
-                        f"in queue (deadline_s="
-                        f"{self.config.deadline_s})"))
-                if self.tracer is not None and r.span is not None:
-                    self.tracer.finish(r.span, now)
-                    r.span = None
+                self._expire_request(r, now)
             else:
                 live.append(r)
         return live
+
+    def _reap_expired(self) -> None:
+        """Deadline timer [ISSUE 11 bugfix]: periodically scan the
+        QUEUED requests (under the queue's own mutex — a snapshot, no
+        dequeue) and fail any that aged past ``deadline_s``. The
+        dispatch path skips already-done futures, so a request expires
+        exactly once no matter who sees it first; a producer blocked on
+        a wedged batcher gets its typed failure in bounded time instead
+        of rotting."""
+        deadline = self.config.deadline_s
+        interval = min(max(deadline / 4.0, 0.005), 0.25)
+        while not self._closed:
+            time.sleep(interval)
+            now = time.perf_counter()
+            with self._q.mutex:
+                stale = [r for r in self._q.queue
+                         if r is not None and not r.future.done()
+                         and now - r.t_enqueue > deadline]
+            for r in stale:
+                self._expire_request(r, now)
 
     @staticmethod
     def _runs(batch: List[_Request]) -> List[Tuple[str, List[_Request]]]:
@@ -616,7 +660,11 @@ class MicroBatchEngine:
         self._c_events.inc(len(scores))
         self._c_pairs.inc(spent)
         for r in run:
-            r.future.set_result(len(r.scores))
+            # a request the reaper expired mid-flight already holds its
+            # typed failure; the event is applied either way (WAL-first
+            # ordering), the future just reports the deadline truthfully
+            if not r.future.done():
+                r.future.set_result(len(r.scores))
         t_end = time.perf_counter()              # resolve ends
         n = len(run)
         h = self._h_stage
@@ -680,7 +728,8 @@ class MicroBatchEngine:
         off = 0
         for r in run:
             n = len(r.scores)
-            r.future.set_result(ranks[off:off + n])
+            if not r.future.done():
+                r.future.set_result(ranks[off:off + n])
             off += n
 
     # ------------------------------------------------------------------ #

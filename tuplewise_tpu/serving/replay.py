@@ -73,7 +73,8 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
            metrics_every_s: float = 1.0,
            profile_dir: Optional[str] = None,
            flight_out: Optional[str] = None,
-           slo_spec=None, run_id: Optional[str] = None,
+           slo_spec=None, controller_spec=None,
+           run_id: Optional[str] = None,
            **overrides) -> dict:
     """Drive the engine with one request per event (or per ``chunk``
     events) and return the measurement record.
@@ -147,6 +148,7 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     futures = []
     flusher = None
     slo_monitor = None
+    controller = None
     with MicroBatchEngine(cfg, chaos=injector, tracer=tracer) as eng:
         if slo_spec is not None:
             from tuplewise_tpu.obs.slo import SloMonitor
@@ -154,6 +156,18 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
             slo_monitor = SloMonitor(
                 slo_spec, registry=eng.metrics, flight=eng.flight,
                 context=dataclasses.asdict(cfg))
+        if controller_spec is not None:
+            # control plane [ISSUE 11]: rides the SLO monitor's
+            # actuator hook (the single-tenant engine gets the flush
+            # knob; tenant/mesh knobs need the fleet)
+            if slo_monitor is None:
+                raise ValueError(
+                    "controller_spec needs slo_spec: the controller "
+                    "rides the SLO monitor's signals")
+            from tuplewise_tpu.serving.control import FleetController
+
+            controller = FleetController(
+                eng, controller_spec).attach(slo_monitor)
         if metrics_out or slo_monitor is not None:
             from tuplewise_tpu.obs.metrics_export import MetricsFlusher
 
@@ -307,6 +321,8 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     rec["report"] = service_report(stats["metrics"], slo=slo_monitor)
     if slo_monitor is not None:
         rec["slo"] = slo_monitor.report()
+    if controller is not None:
+        rec["controller"] = controller.state()
     if trace_out and tracer is not None:
         if trace_out.endswith(".jsonl"):
             tracer.export_jsonl(trace_out)
@@ -350,7 +366,8 @@ def replay_fleet(scores, labels, tenants,
                  config: Optional[ServingConfig] = None,
                  tenancy=None, chunk: int = 1,
                  max_inflight: Optional[int] = None, chaos=None,
-                 slo_spec=None, metrics_out: Optional[str] = None,
+                 slo_spec=None, controller_spec=None,
+                 metrics_out: Optional[str] = None,
                  metrics_every_s: float = 1.0,
                  flight_out: Optional[str] = None,
                  run_id: Optional[str] = None, warmup: bool = False,
@@ -383,6 +400,7 @@ def replay_fleet(scores, labels, tenants,
     """
     from tuplewise_tpu.serving.tenancy import (
         MultiTenantEngine, TenancyConfig, TenantRejectedError,
+        TenantThrottledError,
     )
 
     scores = np.asarray(scores, dtype=np.float64).ravel()
@@ -405,9 +423,11 @@ def replay_fleet(scores, labels, tenants,
                      max_inflight=max_inflight, oracle_check=False)
     admitted = np.ones(n, dtype=bool)
     rejected = poison_rejected = tenant_rejected = 0
+    tenant_throttled = 0
     futures = []
     flusher = None
     slo_monitor = None
+    controller = None
     with MultiTenantEngine(cfg, ten_cfg, chaos=injector) as eng:
         if slo_spec is not None:
             from tuplewise_tpu.obs.slo import SloMonitor
@@ -415,6 +435,16 @@ def replay_fleet(scores, labels, tenants,
             slo_monitor = SloMonitor(
                 slo_spec, registry=eng.metrics, flight=eng.flight,
                 context=dataclasses.asdict(cfg))
+        if controller_spec is not None:
+            # SLO-driven control plane [ISSUE 11]
+            if slo_monitor is None:
+                raise ValueError(
+                    "controller_spec needs slo_spec: the controller "
+                    "rides the SLO monitor's signals")
+            from tuplewise_tpu.serving.control import FleetController
+
+            controller = FleetController(
+                eng, controller_spec).attach(slo_monitor)
         if metrics_out or slo_monitor is not None:
             from tuplewise_tpu.obs.metrics_export import MetricsFlusher
 
@@ -444,6 +474,12 @@ def replay_fleet(scores, labels, tenants,
                 futures.append(eng.insert(tid, sub, labels[i:j]))
             except PoisonEventError:
                 poison_rejected += j - i
+                admitted[i:j] = False
+            except TenantThrottledError:
+                # control-plane shed [ISSUE 11]: typed, retry-after-
+                # hinted; the replay drops rather than retries, so the
+                # oracle check runs over the admitted events only
+                tenant_throttled += j - i
                 admitted[i:j] = False
             except TenantRejectedError:
                 tenant_rejected += j - i
@@ -504,6 +540,7 @@ def replay_fleet(scores, labels, tenants,
         "events_applied": int(applied),
         "events_rejected": int(rejected),
         "events_tenant_rejected": int(tenant_rejected),
+        "events_tenant_throttled": int(tenant_throttled),
         "events_poison_rejected": int(poison_rejected),
         "requests_dropped": int(dropped),
         "wall_s": wall,
@@ -519,6 +556,8 @@ def replay_fleet(scores, labels, tenants,
         "admission": {
             "tenant_rejected_total": m.get(
                 "tenant_rejected_total", {}).get("value", 0),
+            "tenant_throttled_total": m.get(
+                "tenant_throttled_total", {}).get("value", 0),
             "rejected_total": m.get("rejected_total", {}).get("value", 0),
             "dropped_total": m.get("dropped_total", {}).get("value", 0),
             "tenants_created_total": m.get(
@@ -563,6 +602,8 @@ def replay_fleet(scores, labels, tenants,
     rec["report"] = service_report(m, chaos=injector, slo=slo_monitor)
     if slo_monitor is not None:
         rec["slo"] = slo_monitor.report()
+    if controller is not None:
+        rec["controller"] = controller.state()
     if metrics_out:
         rec["metrics_out"] = metrics_out
     if injector is not None:
@@ -572,7 +613,9 @@ def replay_fleet(scores, labels, tenants,
     # per-tenant oracle parity [ISSUE 8 acceptance]: each tenant's
     # exact AUC vs the batch oracle over ITS admitted (windowed)
     # events — the fleet must be indistinguishable from T independent
-    # single-tenant engines
+    # single-tenant engines. Control-plane throttles are allowed:
+    # admission-side sheds are excluded from the oracle by the
+    # admitted mask, exactly like poison [ISSUE 11]
     if oracle_check and rejected == 0 and dropped == 0 \
             and tenant_rejected == 0:
         from tuplewise_tpu.models.metrics import auc_score

@@ -98,7 +98,8 @@ import numpy as np
 from tuplewise_tpu.obs.flight import FlightRecorder
 from tuplewise_tpu.obs.tracing import maybe_span
 from tuplewise_tpu.serving.engine import (
-    BackpressureError, EngineClosedError, PoisonEventError, ServingConfig,
+    BackpressureError, DeadlineExceededError, EngineClosedError,
+    PoisonEventError, ServingConfig,
 )
 from tuplewise_tpu.serving.index import _remove_sorted, _splice_merge
 from tuplewise_tpu.serving.recovery import RecoveryManager
@@ -116,6 +117,23 @@ class TenantRejectedError(RuntimeError):
     def __init__(self, msg: str, tenant: Optional[str] = None):
         super().__init__(msg)
         self.tenant = tenant
+
+
+class TenantThrottledError(RuntimeError):
+    """The control plane shed this request BEFORE a breach
+    [ISSUE 11]: the tenant is temporarily throttled (typically because
+    its traffic is driving the fleet toward an SLO breach), and the
+    caller should retry after ``retry_after_s`` seconds. Distinct from
+    :class:`TenantRejectedError` (a static quota/cap verdict): a
+    throttle is a *temporary, reversible* actuation with an explicit
+    retry hint — the difference between "come back in 500 ms" and
+    "you are over quota"."""
+
+    def __init__(self, msg: str, tenant: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -445,10 +463,21 @@ class TenantFleetIndex:
         self.last_compactor_error = None
         self._healer = None
         if shards is not None:
+            import jax
+
             from tuplewise_tpu.parallel.self_heal import Backoff, MeshHealer
 
+            # pool = current mesh devices first (so a shrink+regrow
+            # restores the same devices), spares after — what lets the
+            # control plane GROW the mesh past its initial width
+            # [ISSUE 11]; heal-shrink semantics are unchanged (shrink
+            # rebuilds over the CURRENT mesh's survivors, never the
+            # pool)
+            mesh_devs = list(self._mesh.devices.flat)
+            pool = mesh_devs + [d for d in jax.devices()
+                                if d not in mesh_devs]
             self._healer = MeshHealer(
-                self._mesh, chaos=chaos,
+                self._mesh, pool=pool, chaos=chaos,
                 probe_timeout_s=probe_timeout_s, metrics=self.metrics,
                 backoff=Backoff(base_s=retry_backoff_s, cap_s=1.0),
                 tracer=tracer, flight=flight)
@@ -623,6 +652,25 @@ class TenantFleetIndex:
         self._g_mesh.set(self.shards)
         self._pos_pack.mark_all()
         self._neg_pack.mark_all()
+
+    def resize_shards(self, shards: int) -> bool:
+        """Control-plane mesh re-width [ISSUE 11]: rebuild the 1-D
+        mesh at ``shards`` workers from the healer's device pool and
+        re-place the packs at the next count — counts are additive
+        over any partition, so per-tenant results are BIT-IDENTICAL at
+        every width (the same invariant device-loss healing relies
+        on). Returns True when the width changed; False for unsharded
+        fleets, no-op widths, or widths the surviving pool cannot
+        supply. Promoted whales keep their existing mesh reference
+        (their devices are still alive — a resize is an actuation, not
+        a failure); new whales adopt the resized mesh."""
+        with self._lock:
+            if self._healer is None:
+                return False
+            if not self._healer.resize(shards):
+                return False
+            self._on_heal(self._healer)
+            return True
 
     def _fleet_base_counts(self, q_vs_neg: List[np.ndarray],
                            q_vs_pos: List[np.ndarray],
@@ -1433,6 +1481,10 @@ class MultiTenantEngine:
         self._c_pairs = m.counter("incomplete_pairs_total")
         self._c_poison = m.counter("poison_rejects")
         self._c_batcher_restarts = m.counter("batcher_restarts")
+        self._c_deadline = m.counter("deadline_expired_total")
+        # control-plane shedding [ISSUE 11]: typed, per-tenant,
+        # BEFORE a breach — mirrors the tenant_rejected plumbing
+        self._c_throttled = m.counter("tenant_throttled_total")
         self._h_latency = m.histogram("request_latency_s")
         self._h_insert_lat = m.histogram("insert_latency_s")
         self._h_fill = m.histogram(
@@ -1446,6 +1498,13 @@ class MultiTenantEngine:
         self._cv = threading.Condition()
         self._closed = False
         self._last_idle_check = time.monotonic()
+        # control-plane overrides [ISSUE 11]: the FleetController's
+        # reversible actuations. All default-empty, so a controller-off
+        # engine takes the exact pre-ISSUE-11 paths (the `.get(tid,
+        # default)` reads below resolve to today's static config).
+        self._throttles: Dict[str, Tuple[float, float]] = {}
+        self._tenant_weights: Dict[str, int] = {}
+        self._tenant_quotas: Dict[str, int] = {}
         self._recovery = None
         if config.snapshot_dir:
             self._recovery = FleetRecoveryManager(
@@ -1461,6 +1520,15 @@ class MultiTenantEngine:
             target=self._supervise, name="tuplewise-fleet-batcher",
             daemon=True)
         self._worker.start()
+        # deadline reaper [ISSUE 11 bugfix]: the fleet twin of the
+        # single-engine timer — over-deadline pending requests fail
+        # typed on a timer, not only when the batcher gets to them
+        self._reaper = None
+        if config.deadline_s is not None:
+            self._reaper = threading.Thread(
+                target=self._reap_expired,
+                name="tuplewise-fleet-reaper", daemon=True)
+            self._reaper.start()
 
     # ------------------------------------------------------------------ #
     # tenant lifecycle                                                   #
@@ -1538,6 +1606,131 @@ class MultiTenantEngine:
                 self.drop_tenant(tid)
 
     # ------------------------------------------------------------------ #
+    # control-plane actuation surface [ISSUE 11]                         #
+    # ------------------------------------------------------------------ #
+    def throttle_tenant(self, tid: str,
+                        retry_after_s: float = 0.5) -> None:
+        """Shed ``tid``'s NEW requests for ``retry_after_s`` seconds
+        with a typed :class:`TenantThrottledError` carrying the retry
+        hint. Auto-expires (reversible by construction); re-issue to
+        extend. Already-queued requests still apply — a throttle
+        affects admission, never applied state."""
+        with self._cv:
+            self._throttles[str(tid)] = (
+                time.monotonic() + retry_after_s, retry_after_s)
+
+    def clear_throttles(self, tid: Optional[str] = None) -> int:
+        """Lift one tenant's throttle (or all); returns how many."""
+        with self._cv:
+            if tid is not None:
+                return 1 if self._throttles.pop(str(tid), None) else 0
+            n = len(self._throttles)
+            self._throttles.clear()
+            return n
+
+    def throttled_tenants(self) -> List[str]:
+        now = time.monotonic()
+        with self._cv:
+            return [t for t, (until, _) in self._throttles.items()
+                    if until > now]
+
+    def set_tenant_weight(self, tid: str,
+                          weight: Optional[int]) -> None:
+        """Override one tenant's DRR quantum (None restores the
+        config default) — the controller's fairness rebalance knob."""
+        with self._cv:
+            if weight is None:
+                self._tenant_weights.pop(str(tid), None)
+            else:
+                self._tenant_weights[str(tid)] = max(1, int(weight))
+
+    def set_tenant_quota(self, tid: str,
+                         quota: Optional[int]) -> None:
+        """Override one tenant's queued-request quota (None restores
+        the config default)."""
+        with self._cv:
+            if quota is None:
+                self._tenant_quotas.pop(str(tid), None)
+            else:
+                self._tenant_quotas[str(tid)] = max(1, int(quota))
+
+    def pending_by_tenant(self) -> Dict[str, int]:
+        """Queued (unapplied) request counts per tenant — the
+        controller's who-is-flooding-the-queue signal."""
+        with self._cv:
+            return {t: len(dq) for t, dq in self._pending.items()}
+
+    def _check_throttle(self, tenant: str) -> None:
+        th = self._throttles.get(tenant)
+        if th is None:
+            return
+        until, _ = th
+        remaining = until - time.monotonic()
+        if remaining <= 0:
+            with self._cv:
+                # expired: drop it (unless re-issued meanwhile)
+                if self._throttles.get(tenant, (0, 0))[0] <= \
+                        time.monotonic():
+                    self._throttles.pop(tenant, None)
+            return
+        self._c_throttled.inc()
+        if self.tenancy.tenant_metrics:
+            self.metrics.counter(
+                "tenant_throttled_total",
+                labels={"tenant": self._metric_tenant(tenant)}).inc()
+        self.flight.record("tenant_throttled", tenant=tenant,
+                           retry_after_s=remaining)
+        raise TenantThrottledError(
+            f"tenant {tenant!r} throttled by the control plane; "
+            f"retry after {remaining:.3f}s", tenant=tenant,
+            retry_after_s=remaining)
+
+    def _reap_expired(self) -> None:
+        """Fleet deadline timer [ISSUE 11 bugfix]: fail over-deadline
+        pending requests typed and REMOVE them from their tenant
+        queues, so a wedged or idle batcher cannot let them rot (and
+        their quota slots free up)."""
+        deadline = self.config.deadline_s
+        interval = min(max(deadline / 4.0, 0.005), 0.25)
+        while not self._closed:
+            time.sleep(interval)
+            now = time.perf_counter()
+            expired: List[_FleetRequest] = []
+            with self._cv:
+                for tid in list(self._pending):
+                    dq = self._pending[tid]
+                    keep = collections.deque(
+                        r for r in dq
+                        if now - r.t_enqueue <= deadline)
+                    if len(keep) != len(dq):
+                        expired.extend(
+                            r for r in dq
+                            if now - r.t_enqueue > deadline)
+                        self._n_pending -= len(dq) - len(keep)
+                        if keep:
+                            self._pending[tid] = keep
+                        else:
+                            del self._pending[tid]
+                            self._rotation.remove(tid)
+                if expired:
+                    self._cv.notify_all()   # capacity freed
+            for r in expired:
+                if r.future.done():
+                    continue
+                try:
+                    r.future.set_exception(DeadlineExceededError(
+                        f"request expired after "
+                        f"{now - r.t_enqueue:.3f}s in queue "
+                        f"(deadline_s={deadline}, tenant={r.tenant})"))
+                except Exception:   # noqa: BLE001 — lost the race
+                    continue
+                self._c_deadline.inc()
+                self.flight.record(
+                    "deadline_expired", kind_req=r.kind,
+                    tenant=r.tenant, waited_s=now - r.t_enqueue)
+                self._finish(r, now)
+
+    # ------------------------------------------------------------------ #
     # request side                                                       #
     # ------------------------------------------------------------------ #
     def submit(self, kind: str, tenant, scores=None,
@@ -1556,6 +1749,10 @@ class MultiTenantEngine:
         if self._closed:
             raise EngineClosedError(
                 f"engine is closed (tenant={tenant})", tenant=tenant)
+        # control-plane shed [ISSUE 11]: the cheapest possible edge —
+        # before validation, before tenant creation, before any shared
+        # resource is touched
+        self._check_throttle(tenant)
         if kind == "insert":
             scores, labels = self._validate_insert(tenant, scores, labels)
         elif kind == "score":
@@ -1570,7 +1767,9 @@ class MultiTenantEngine:
         self._c_req[kind].inc()
         with self._cv:
             dq = self._pending.get(tenant)
-            if dq is not None and len(dq) >= self.tenancy.tenant_quota:
+            quota = self._tenant_quotas.get(tenant,
+                                            self.tenancy.tenant_quota)
+            if dq is not None and len(dq) >= quota:
                 self._c_tenant_rejected.inc()
                 if self.tenancy.tenant_metrics:
                     self.metrics.counter(
@@ -1579,7 +1778,7 @@ class MultiTenantEngine:
                                 self._metric_tenant(tenant)}).inc()
                 raise TenantRejectedError(
                     f"tenant {tenant!r} queue quota "
-                    f"({self.tenancy.tenant_quota}) exceeded",
+                    f"({quota}) exceeded",
                     tenant=tenant)
             while self._n_pending >= self.config.queue_size:
                 if self.config.policy == "reject":
@@ -1601,6 +1800,11 @@ class MultiTenantEngine:
                 self._rotation.append(tenant)
             dq.append(req)
             self._n_pending += 1
+            # live queue depth at submit too [ISSUE 11]: the
+            # saturation objective (and the controller riding it) must
+            # see backlog as it BUILDS, not only when the batcher next
+            # drains — one attribute store under the lock already held
+            self._g_depth.set(self._n_pending)
             self._cv.notify_all()
         return req.future
 
@@ -1709,8 +1913,13 @@ class MultiTenantEngine:
                 if remaining <= 0:
                     break
                 self._cv.wait(timeout=remaining)
-            self._g_depth.set(self._n_pending)
             batch = self._drr_take(self.config.max_batch)
+            # the gauge tracks REMAINING backlog: set post-take (and
+            # at every submit), so a drained queue reads low instead
+            # of holding the last pre-drain peak — the saturation
+            # objective (and the controller) must see recovery too
+            # [ISSUE 11]
+            self._g_depth.set(self._n_pending)
             self._inflight = len(batch)
             self._cv.notify_all()    # capacity freed: wake producers
             return batch
@@ -1720,12 +1929,14 @@ class MultiTenantEngine:
         is served up to ``weight`` requests per round before any
         tenant is served twice — the starvation-free order."""
         out: List[_FleetRequest] = []
-        w = self.tenancy.weight
         while len(out) < n and self._rotation:
             tid = self._rotation.pop(0)
             dq = self._pending.get(tid)
             if dq is None:
                 continue
+            # per-tenant quantum override [ISSUE 11]: the controller's
+            # fairness rebalance; absent = the static config weight
+            w = self._tenant_weights.get(tid, self.tenancy.weight)
             take = min(w, n - len(out), len(dq))
             for _ in range(take):
                 out.append(dq.popleft())
@@ -1815,9 +2026,16 @@ class MultiTenantEngine:
         for tid, reqs in groups:
             h_tenant = None
             if self.tenancy.tenant_metrics:
+                mt = self._metric_tenant(tid)
                 h_tenant = self.metrics.histogram(
-                    "insert_latency_s",
-                    labels={"tenant": self._metric_tenant(tid)})
+                    "insert_latency_s", labels={"tenant": mt})
+                # per-tenant event counter [ISSUE 11]: the traffic-
+                # SLOPE signal the controller differentiates for shed
+                # ordering and preemptive whale promotion (the latency
+                # histogram counts REQUESTS, not events)
+                self.metrics.counter(
+                    "tenant_events_total", labels={"tenant": mt}).inc(
+                    sum(len(r.scores) for r in reqs))
             for r in reqs:
                 if not r.future.done():
                     r.future.set_result(len(r.scores))
