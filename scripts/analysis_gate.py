@@ -1,0 +1,71 @@
+"""CI leg for the static invariant checkers [ISSUE 12]: run
+``tuplewise check`` in-process, write the JSON report artifact, and
+fail on any unwaived finding, waiver-file error, parse error, or
+import cycle.
+
+The ratchet lives in the waiver semantics themselves (each waiver
+absorbs a bounded count — see analysis/waivers.py), so this gate has
+no separate baseline file to drift: a new violation anywhere fails
+even where old waived ones exist.
+
+Usage: python scripts/analysis_gate.py [--out results/analysis_report.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "analysis_report.json"))
+    args = ap.parse_args(argv)
+
+    from tuplewise_tpu.analysis.runner import run_checks
+
+    report = run_checks(root=REPO)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2)
+
+    s = report["summary"]
+    print(f"ANALYSIS GATE: {s['files_analyzed']} files, "
+          f"{s['findings_total']} findings "
+          f"({s['waived']} waived, {s['unwaived']} unwaived), "
+          f"{len(report['import_cycles'])} import cycles, "
+          f"{len(report['dead_symbols'])} dead public symbols "
+          f"(warn-only)", file=sys.stderr)
+    for f_ in report["findings"]:
+        print(f"  UNWAIVED {f_['rule']}: {f_['file']}:{f_['line']} "
+              f"[{f_['symbol']}] {f_['message']}", file=sys.stderr)
+    if report.get("waiver_error"):
+        print(f"  WAIVER FILE ERROR: {report['waiver_error']}",
+              file=sys.stderr)
+    for w in report["unused_waivers"]:
+        print(f"  stale waiver: {w['rule']} {w['file']} "
+              f"[{w['symbol']}] (waivers.toml:{w['line']})",
+              file=sys.stderr)
+    # one machine-readable verdict line on stdout (the doctor/perf-gate
+    # convention: tail -n 1 | json)
+    print(json.dumps({"stage": "analysis_gate", "ok": report["ok"],
+                      "unwaived": s["unwaived"],
+                      "waived": s["waived"],
+                      "unused_waivers": s["waivers_unused"]}))
+    if not report["ok"]:
+        print("ANALYSIS GATE FAIL (report in "
+              f"{args.out})", file=sys.stderr)
+        return 1
+    print("ANALYSIS GATE OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,17 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
+# Static invariant checks [ISSUE 12] — FIRST, because they need no
+# jax and fail in seconds: lock-order/thread discipline, traced-code
+# purity, telemetry cross-reference, compile-ladder discipline,
+# config/CLI/doc drift, import cycles. Findings are suppressible only
+# via the committed tuplewise_tpu/analysis/waivers.toml (bounded
+# per-waiver counts = the ratchet); the JSON report lands at
+# results/analysis_report.json for the CI artifact.
+timeout -k 10 120 python scripts/analysis_gate.py
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \

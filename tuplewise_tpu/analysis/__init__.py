@@ -1,0 +1,50 @@
+"""Static invariant checkers for the serving stack [ISSUE 12].
+
+Eleven PRs of conventions no tool enforced — lock discipline across the
+thread roles, the (T_bucket, cap, q_bucket) compile-shape ladder,
+integer-exact signed-multiset math, and a telemetry namespace consumed
+by doctor/SLO/perf-gate by string match — become five AST-based passes
+(stdlib ``ast`` only, no new deps), exposed as ``tuplewise check`` and
+a fail-mode CI leg (``scripts/analysis_gate.py``):
+
+* ``lock-order``      — static lock-acquisition graph, acquisition-
+                        order cycles, locks held across blocking ops
+                        (device dispatch, unbounded queue put/get,
+                        fsync, ``Future.result``).
+* ``traced-purity``   — inside code reached by ``jax.jit`` /
+                        ``pallas_call`` / ``shard_map``: no wall-clock
+                        reads, no unseeded host RNG, no ``float()``
+                        coercions, no ``.item()`` / device syncs.
+* ``telemetry-xref``  — every metric / flight-event kind / span name
+                        consumed by doctor / SLO / report / perf-gate
+                        (or documented) must have a matching producer.
+* ``compile-ladder``  — shape-determining args into the jitted/Pallas
+                        count factories must pass through the bucket
+                        helpers, never raw ``len()``-derived values.
+* ``config-drift``    — ServingConfig/TenancyConfig/ControllerConfig
+                        fields <-> CLI flags <-> README/DESIGN mentions
+                        must agree.
+
+Findings are suppressible ONLY via the committed, per-finding-justified
+waiver file (``analysis/waivers.toml``); each waiver absorbs a bounded
+count of findings, so NEW violations fail even where old waived ones
+exist (the ratchet). The shared module graph also emits an import-cycle
+report (fail on new top-level cycles) and a warn-only dead-public-
+symbol list. DESIGN §17 documents the rule catalogue and waiver policy.
+"""
+
+from tuplewise_tpu.analysis.core import Finding, ModuleSet
+
+__all__ = ["Finding", "ModuleSet", "PASSES", "run_checks"]
+
+
+def __getattr__(name):
+    # lazy: the runner imports every pass module, and the passes import
+    # this package — a top-level import here would be exactly the
+    # import cycle the module-graph report exists to forbid
+    if name in ("PASSES", "run_checks"):
+        from tuplewise_tpu.analysis import runner
+
+        return getattr(runner, {"PASSES": "PASSES",
+                                "run_checks": "run_checks"}[name])
+    raise AttributeError(name)

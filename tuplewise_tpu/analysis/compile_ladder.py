@@ -1,0 +1,137 @@
+"""Pass 4 — compile-ladder discipline [ISSUE 12].
+
+The XLA/Pallas compile-cache stays bounded ONLY because every shape a
+jitted count function is built for comes off the power-of-two bucket
+ladders ((T_bucket, cap, q_bucket) — DESIGN §8/§15). The jit factories
+are the chokepoint: every ``@functools.lru_cache`` function whose
+returned callable is jitted (``_jit_count_fn``, ``sharded_count_fn``,
+``delta_append_fn``, ``_merge_*_fn``, ...) keys its cache — and the
+compiled-shape universe — on its integer arguments.
+
+Rule ``ladder-raw-shape``: at any call site of such a factory, a
+shape-determining argument whose expression derives directly from
+``len(...)`` / ``.shape`` / ``.size`` without passing through a bucket
+helper (``next_bucket`` / ``_next_bucket`` / ``_t_bucket``) compiles
+one program per distinct live size — unbounded cache growth and a
+recompile storm under churn. One level of local assignment is chased:
+``qb = len(q)`` then ``f(qb)`` is flagged; ``qb = next_bucket(len(q))``
+is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tuplewise_tpu.analysis.core import (
+    Finding, ModuleSet, call_name, dotted,
+)
+
+_BUCKET_HELPERS = {"next_bucket", "_next_bucket", "_t_bucket",
+                   "self._t_bucket"}
+
+
+def _is_lru(node: ast.AST) -> bool:
+    for deco in getattr(node, "decorator_list", ()):
+        d = deco
+        if isinstance(d, ast.Call):
+            d = d.func
+        name = dotted(d)
+        if name in ("functools.lru_cache", "lru_cache",
+                    "functools.cache", "cache"):
+            return True
+    return False
+
+
+def ladder_factories(ms: ModuleSet) -> Dict[str, Set[int]]:
+    """{factory name: shape-arg positions} — every lru_cache'd
+    function in the corpus; the cache key IS the compile-shape key, so
+    every non-mesh positional argument is shape-determining."""
+    out: Dict[str, Set[int]] = {}
+    for path, mi in ms.modules.items():
+        for fi in mi.iter_functions():
+            node = fi.node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not _is_lru(node):
+                continue
+            positions = set()
+            for i, arg in enumerate(node.args.args):
+                if arg.arg in ("mesh", "self", "cls", "dtype",
+                               "kernel", "interpret"):
+                    continue
+                positions.add(i)
+            if positions:
+                out[node.name] = positions
+    return out
+
+
+def _raw_shape(expr: ast.AST) -> Optional[str]:
+    """The offending sub-expression when ``expr`` derives a raw size,
+    ignoring anything wrapped in a bucket helper."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            cn = call_name(node)
+            if cn in _BUCKET_HELPERS or (
+                    cn and cn.split(".")[-1] in _BUCKET_HELPERS):
+                # prune: children of a bucket call are sanctioned.
+                # ast.walk can't prune, so check containment instead.
+                sanctioned = set(ast.walk(node))
+                return _raw_shape_outside(expr, sanctioned)
+    return _raw_shape_outside(expr, set())
+
+
+def _raw_shape_outside(expr: ast.AST, sanctioned) -> Optional[str]:
+    for node in ast.walk(expr):
+        if node in sanctioned:
+            continue
+        if isinstance(node, ast.Call) and call_name(node) == "len":
+            return "len(...)"
+        if isinstance(node, ast.Attribute) and node.attr in ("shape",
+                                                             "size"):
+            return f".{node.attr}"
+    return None
+
+
+def run(ms: ModuleSet) -> List[Finding]:
+    factories = ladder_factories(ms)
+    findings: List[Finding] = []
+    for path, mi in ms.modules.items():
+        for fi in mi.iter_functions():
+            # local one-level assignment map: name -> value expr
+            assigns: Dict[str, ast.AST] = {}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    assigns[node.targets[0].id] = node.value
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = call_name(node)
+                leaf = cn.split(".")[-1] if cn else None
+                if leaf not in factories:
+                    continue
+                # skip the factory's own definition module self-call?
+                # no — a raw-size call inside the defining module is
+                # exactly as wrong as anywhere else.
+                for i, arg in enumerate(node.args):
+                    if i not in factories[leaf]:
+                        continue
+                    expr = arg
+                    label = ast.dump(arg)[:0]  # unused; keep expr
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in assigns:
+                        expr = assigns[arg.id]
+                    bad = _raw_shape(expr)
+                    if bad is not None:
+                        findings.append(Finding(
+                            "ladder-raw-shape", path, node.lineno,
+                            f"{fi.qualname}::{leaf}:{i}",
+                            f"{fi.qualname} passes a raw {bad}-derived"
+                            f" size as shape arg {i} of {leaf}() — "
+                            "shape-determining values must come off "
+                            "the bucket ladder (next_bucket) or XLA "
+                            "compiles one program per live size"))
+    return findings

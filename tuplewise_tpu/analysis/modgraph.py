@@ -1,0 +1,109 @@
+"""Module-graph report [ISSUE 12 satellite]: import cycles (fail) and
+dead public symbols (warn-only).
+
+* ``import-cycle`` — a cycle among TOP-LEVEL imports inside
+  ``tuplewise_tpu``. Function-local (lazy) imports are exempt: the
+  repo lazy-imports deliberately to keep jax off the cold path, and a
+  lazy edge cannot deadlock module init. A new top-level cycle fails
+  CI like any other finding.
+* dead symbols — module-level public (non-underscore) functions and
+  classes in ``tuplewise_tpu`` that no other corpus file references by
+  name. Reported in the JSON (``dead_symbols``) for humans; NOT a
+  failing finding — public API kept for external callers is
+  legitimate, and name-reference analysis has false negatives.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from tuplewise_tpu.analysis.core import Finding, ModuleSet
+
+_PKG = "tuplewise_tpu"
+
+
+def import_graph(ms: ModuleSet) -> Dict[str, Set[str]]:
+    """Top-level (eager) import edges between corpus modules."""
+    graph: Dict[str, Set[str]] = {}
+    for path, mi in ms.modules.items():
+        if not path.startswith(_PKG + "/"):
+            continue
+        mod = ms.module_name(path)
+        edges: Set[str] = set()
+        for node in mi.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith(_PKG):
+                        edges.add(a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.startswith(_PKG):
+                edges.add(node.module)
+        graph[mod] = {e for e in edges
+                      if ms.path_of_module(e) is not None}
+    return graph
+
+
+def find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    from tuplewise_tpu.analysis.lock_order import _cycles
+
+    return _cycles({k: set(v) for k, v in graph.items()})
+
+
+def public_symbols(ms: ModuleSet) -> List[Tuple[str, str, int]]:
+    out = []
+    for path, mi in ms.modules.items():
+        if not path.startswith(_PKG + "/") \
+                or path.endswith("__init__.py"):
+            continue
+        for node in mi.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) \
+                    and not node.name.startswith("_"):
+                out.append((path, node.name, node.lineno))
+    return out
+
+
+def dead_symbols(ms: ModuleSet) -> List[dict]:
+    """Public module-level symbols never referenced by name outside
+    their defining module (corpus-wide word search, tests included —
+    the test tree is read for references even though the passes do not
+    analyze it)."""
+    import os
+
+    refs: Dict[str, Set[str]] = {}
+    sources = {p: mi.source for p, mi in ms.modules.items()}
+    if ms.root:
+        tdir = os.path.join(ms.root, "tests")
+        if os.path.isdir(tdir):
+            for fn in sorted(os.listdir(tdir)):
+                if fn.endswith(".py"):
+                    with open(os.path.join(tdir, fn), "r",
+                              encoding="utf-8") as f:
+                        sources[f"tests/{fn}"] = f.read()
+    names = public_symbols(ms)
+    for path, name, line in names:
+        pat = re.compile(rf"\b{re.escape(name)}\b")
+        used = set()
+        for p, src in sources.items():
+            if p == path:
+                continue
+            if pat.search(src):
+                used.add(p)
+        refs[f"{path}:{name}"] = used
+    return [{"file": path, "symbol": name, "line": line}
+            for path, name, line in names
+            if not refs[f"{path}:{name}"]]
+
+
+def run(ms: ModuleSet) -> List[Finding]:
+    findings = []
+    for cyc in find_cycles(import_graph(ms)):
+        findings.append(Finding(
+            "import-cycle", "<module-graph>", 0,
+            "->".join(sorted(set(cyc))),
+            "top-level import cycle: " + " -> ".join(cyc + [cyc[0]])
+            + " (lazy-import one edge to break module-init order "
+            "dependence)"))
+    return findings

@@ -1,0 +1,142 @@
+"""``tuplewise check`` — run the five invariant passes + the module-
+graph report over the repo, apply the committed waiver file, and
+render one JSON report [ISSUE 12].
+
+Exit status: 0 = no unwaived findings (waived ones are listed, not
+fatal); 1 = at least one unwaived finding, a malformed waiver file, or
+(``--strict``) a stale waiver matching nothing. The CI leg
+(``scripts/analysis_gate.py``) runs this in fail mode and uploads the
+report as an artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Callable, List, Optional, Tuple
+
+from tuplewise_tpu.analysis import compile_ladder
+from tuplewise_tpu.analysis import config_drift
+from tuplewise_tpu.analysis import lock_order
+from tuplewise_tpu.analysis import modgraph
+from tuplewise_tpu.analysis import telemetry_xref
+from tuplewise_tpu.analysis import traced_purity
+from tuplewise_tpu.analysis.core import Finding, ModuleSet
+from tuplewise_tpu.analysis.waivers import (
+    WaiverError, apply_waivers, load_waivers,
+)
+
+#: (name, pass callable) — the five invariant passes + import cycles
+PASSES: Tuple[Tuple[str, Callable[[ModuleSet], List[Finding]]], ...] = (
+    ("lock-order", lock_order.run),
+    ("traced-purity", traced_purity.run),
+    ("telemetry-xref", telemetry_xref.run),
+    ("compile-ladder", compile_ladder.run),
+    ("config-drift", config_drift.run),
+    ("module-graph", modgraph.run),
+)
+
+DEFAULT_WAIVERS = "tuplewise_tpu/analysis/waivers.toml"
+
+
+def repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def run_checks(root: Optional[str] = None,
+               waivers_path: Optional[str] = None,
+               strict: bool = False,
+               ms: Optional[ModuleSet] = None) -> dict:
+    """The whole check as one JSON-able report dict; ``ms`` overrides
+    the repo walk (fixture tests)."""
+    root = root or repo_root()
+    if ms is None:
+        ms = ModuleSet.from_repo(root)
+
+    findings: List[Finding] = []
+    per_pass = {}
+    for name, fn in PASSES:
+        fs = fn(ms)
+        per_pass[name] = len(fs)
+        findings.extend(fs)
+    findings.sort(key=lambda f: (f.rule, f.file, f.symbol))
+
+    waiver_error = None
+    waivers = []
+    wpath = waivers_path
+    if wpath is None:
+        cand = os.path.join(root, DEFAULT_WAIVERS)
+        wpath = cand if os.path.exists(cand) else None
+    if wpath:
+        try:
+            with open(wpath, "r", encoding="utf-8") as f:
+                waivers = load_waivers(f.read())
+        except WaiverError as e:
+            waiver_error = str(e)
+
+    unwaived, waived, unused = apply_waivers(findings, waivers)
+
+    ok = not unwaived and waiver_error is None \
+        and not ms.parse_errors and not (strict and unused)
+    report = {
+        "stage": "tuplewise_check",
+        "ok": ok,
+        "summary": {
+            "files_analyzed": len(ms.modules),
+            "findings_total": len(findings),
+            "unwaived": len(unwaived),
+            "waived": len(waived),
+            "waivers_unused": len(unused),
+            "per_pass": per_pass,
+        },
+        "findings": [f.to_dict() for f in unwaived],
+        "waived": [dict(f.to_dict(), reason=w.reason,
+                        waiver_line=w.line) for f, w in waived],
+        "unused_waivers": [
+            {"rule": w.rule, "file": w.file, "symbol": w.symbol,
+             "line": w.line} for w in unused],
+        "parse_errors": dict(ms.parse_errors),
+        "import_cycles": [
+            cyc for cyc in modgraph.find_cycles(
+                modgraph.import_graph(ms))],
+        "dead_symbols": modgraph.dead_symbols(ms),
+    }
+    if waiver_error is not None:
+        report["waiver_error"] = waiver_error
+    return report
+
+
+def main(args) -> int:
+    """CLI entry (argparse namespace from harness/cli.py)."""
+    report = run_checks(root=args.root, waivers_path=args.waivers,
+                        strict=args.strict)
+    if args.out:
+        d = os.path.dirname(args.out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        s = report["summary"]
+        print(f"tuplewise check: {s['files_analyzed']} files, "
+              f"{s['findings_total']} findings "
+              f"({s['waived']} waived, {s['unwaived']} unwaived)")
+        for f in report["findings"]:
+            print(f"  {f['rule']}: {f['file']}:{f['line']} "
+                  f"[{f['symbol']}]\n    {f['message']}")
+        if report.get("waiver_error"):
+            print(f"  waiver file error: {report['waiver_error']}",
+                  file=sys.stderr)
+        for w in report["unused_waivers"]:
+            print(f"  stale waiver (matched nothing): {w['rule']} "
+                  f"{w['file']} [{w['symbol']}] "
+                  f"(waivers.toml:{w['line']})")
+        if report["dead_symbols"]:
+            print(f"  note: {len(report['dead_symbols'])} unreferenced "
+                  "public symbols (warn-only; see --json)")
+        print("OK" if report["ok"] else "FAIL")
+    return 0 if report["ok"] else 1

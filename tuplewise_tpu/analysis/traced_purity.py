@@ -1,0 +1,204 @@
+"""Pass 2 — traced-code purity [ISSUE 12].
+
+The integer-exactness and determinism contracts DESIGN §15 states in
+prose, enforced: inside any function reached by ``jax.jit`` /
+``pl.pallas_call`` / ``jax.shard_map`` (decorator, wrapper call, or
+kernel argument), and everything those functions call within the
+corpus, forbid:
+
+* wall-clock reads (``time.time`` / ``perf_counter`` / ``monotonic``,
+  ``datetime.now``) — a traced timestamp is a constant baked at trace
+  time, silently wrong forever after (rule ``traced-wall-clock``);
+* unseeded host RNG (``np.random.*``, ``random.*``) — traced once,
+  then replayed as a constant; determinism AND statistics break (rule
+  ``traced-host-rng``; ``jax.random`` with explicit keys is the
+  sanctioned path, see utils/rng);
+* host ``float()`` coercion — the exact integer count path must never
+  detour through host floats (rule ``traced-float-coercion``);
+* implicit device syncs: ``.item()``, ``np.asarray`` on traced values,
+  ``.block_until_ready()`` — a sync inside traced code either fails to
+  trace or serializes the very dispatch the kernel fuses (rule
+  ``traced-device-sync``).
+
+Reachability is a fixpoint over the corpus call graph from the traced
+roots; only confidently-resolved calls (local defs, imported repo
+functions) are followed, so the pass under-approximates rather than
+spraying false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tuplewise_tpu.analysis.core import (
+    Finding, ModuleSet, call_name,
+)
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "ensure_jit"}
+_SHMAP_WRAPPERS = {"jax.shard_map", "shard_map", "jax.experimental."
+                   "shard_map.shard_map"}
+_PALLAS = {"pl.pallas_call", "pallas_call"}
+
+_WALL_CLOCK = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.time_ns", "time.perf_counter_ns",
+               "datetime.now", "datetime.datetime.now",
+               "datetime.utcnow"}
+_SYNC_LEAVES = {"item", "block_until_ready"}
+
+
+def _is_jit_deco(node: ast.AST) -> bool:
+    from tuplewise_tpu.analysis.core import dotted
+
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return dotted(node) in _JIT_WRAPPERS
+    if isinstance(node, ast.Call):
+        cn = call_name(node)
+        if cn in _JIT_WRAPPERS:
+            return True
+        # @partial(jax.jit, static_argnames=...)
+        if cn in ("partial", "functools.partial") and node.args:
+            return dotted(node.args[0]) in _JIT_WRAPPERS
+    return False
+
+
+def _func_arg_names(call: ast.Call, positions) -> List[str]:
+    out = []
+    for i in positions:
+        if i < len(call.args):
+            a = call.args[i]
+            if isinstance(a, ast.Name):
+                out.append(a.id)
+    return out
+
+
+def run(ms: ModuleSet) -> List[Finding]:
+    # 1) collect every function node with a stable key, plus lambdas
+    #    passed to tracing wrappers (lambdas are scanned in place)
+    funcs: Dict[Tuple[str, str], ast.AST] = {}
+    by_name: Dict[str, List[Tuple[str, str]]] = {}
+    for path, mi in ms.modules.items():
+        for fi in mi.iter_functions():
+            funcs[(path, fi.qualname)] = fi.node
+            by_name.setdefault(fi.qualname.split(".")[-1], []).append(
+                (path, fi.qualname))
+
+    roots: Set[Tuple[str, str]] = set()
+    lambda_roots: List[Tuple[str, ast.Lambda]] = []
+
+    def local_lookup(path: str, name: str
+                     ) -> Optional[Tuple[str, str]]:
+        mi = ms.modules[path]
+        # prefer a def in the same module (any nesting), else resolve
+        # the import, else give up
+        cands = [k for k in by_name.get(name, ()) if k[0] == path]
+        if cands:
+            return cands[0]
+        resolved = ms.resolve_import(mi, name)
+        if resolved is not None:
+            tpath, sym = resolved
+            cands = [k for k in by_name.get(sym or name, ())
+                     if k[0] == tpath]
+            if cands:
+                return cands[0]
+        return None
+
+    for path, mi in ms.modules.items():
+        for fi in mi.iter_functions():
+            for deco in getattr(fi.node, "decorator_list", ()):
+                if _is_jit_deco(deco):
+                    roots.add((path, fi.qualname))
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            targets: List[ast.AST] = []
+            if cn in _JIT_WRAPPERS:
+                targets = node.args[:1]
+            elif cn in _SHMAP_WRAPPERS or cn in _PALLAS:
+                targets = node.args[:1]
+            for t in targets:
+                if isinstance(t, ast.Lambda):
+                    lambda_roots.append((path, t))
+                elif isinstance(t, ast.Name):
+                    k = local_lookup(path, t.id)
+                    if k is not None:
+                        roots.add(k)
+
+    # 2) call graph over confidently-resolved calls
+    calls: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+    for (path, qn), node in funcs.items():
+        out: Set[Tuple[str, str]] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                cn = call_name(sub)
+                if cn and "." not in cn:
+                    k = local_lookup(path, cn)
+                    if k is not None and k != (path, qn):
+                        out.add(k)
+        calls[(path, qn)] = out
+
+    reached: Set[Tuple[str, str]] = set()
+    frontier = list(roots)
+    while frontier:
+        k = frontier.pop()
+        if k in reached:
+            continue
+        reached.add(k)
+        frontier.extend(calls.get(k, ()))
+
+    # 3) scan reached bodies (and traced lambdas) for impurities
+    findings: List[Finding] = []
+
+    def scan(path: str, qn: str, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            cn = call_name(sub)
+            if cn is None:
+                continue
+            leaf = cn.split(".")[-1]
+            if cn in _WALL_CLOCK:
+                findings.append(Finding(
+                    "traced-wall-clock", path, sub.lineno,
+                    f"{qn}::{cn}",
+                    f"wall-clock read {cn}() inside traced code "
+                    f"({qn}): traces bake it into the compiled "
+                    "program as a constant"))
+            elif cn.startswith("np.random.") \
+                    or cn.startswith("numpy.random.") \
+                    or cn.startswith("random."):
+                findings.append(Finding(
+                    "traced-host-rng", path, sub.lineno,
+                    f"{qn}::{cn}",
+                    f"host RNG {cn}() inside traced code ({qn}): "
+                    "traced once then replayed as a constant; use "
+                    "jax.random with an explicit key"))
+            elif cn == "float":
+                findings.append(Finding(
+                    "traced-float-coercion", path, sub.lineno,
+                    f"{qn}::float",
+                    f"host float() coercion inside traced code "
+                    f"({qn}): the integer-exact count path must not "
+                    "detour through host floats (DESIGN §15)"))
+            elif leaf in _SYNC_LEAVES or cn in ("np.asarray",
+                                                "numpy.asarray"):
+                findings.append(Finding(
+                    "traced-device-sync", path, sub.lineno,
+                    f"{qn}::{leaf}",
+                    f"implicit device sync {cn}() inside traced code "
+                    f"({qn})"))
+
+    for (path, qn) in sorted(reached):
+        scan(path, qn, funcs[(path, qn)])
+    for path, lam in lambda_roots:
+        scan(path, f"<lambda@{lam.lineno}>", lam)
+
+    # dedupe by fingerprint
+    seen: Set[str] = set()
+    out: List[Finding] = []
+    for f in findings:
+        if f.fingerprint not in seen:
+            seen.add(f.fingerprint)
+            out.append(f)
+    return out

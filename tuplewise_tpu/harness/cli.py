@@ -615,6 +615,32 @@ def main(argv=None) -> int:
                    help="print only the one-line machine verdict")
 
     p = sub.add_parser(
+        "check",
+        help="static invariant checks [ISSUE 12]: lock-order/thread "
+             "discipline, traced-code purity, telemetry cross-"
+             "reference, compile-ladder discipline, config/CLI/doc "
+             "drift, import cycles — findings suppressible only via "
+             "the committed analysis/waivers.toml (DESIGN §17); exit "
+             "0 = clean modulo waivers, 1 = unwaived findings",
+    )
+    p.add_argument("--root", type=str, default=None,
+                   help="repo root to analyze (default: the checkout "
+                        "this package was imported from)")
+    p.add_argument("--waivers", type=str, default=None,
+                   help="waiver file (default: "
+                        "tuplewise_tpu/analysis/waivers.toml under "
+                        "the root)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full JSON report instead of the "
+                        "human summary")
+    p.add_argument("--out", type=str, default=None,
+                   help="also write the JSON report here (the CI "
+                        "artifact)")
+    p.add_argument("--strict", action="store_true",
+                   help="stale waivers (matching nothing) fail the "
+                        "run instead of warning")
+
+    p = sub.add_parser(
         "replay",
         help="replay a synthetic Gaussian stream through the "
              "micro-batch engine; report events/s + latency percentiles",
@@ -635,6 +661,11 @@ def main(argv=None) -> int:
         from tuplewise_tpu.obs.doctor import main as doctor_main
 
         return doctor_main(args)
+
+    if args.cmd == "check":
+        from tuplewise_tpu.analysis.runner import main as check_main
+
+        return check_main(args)
 
     if args.cmd in ("serve", "replay"):
         from tuplewise_tpu.serving import ServingConfig

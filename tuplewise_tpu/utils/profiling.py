@@ -325,25 +325,20 @@ class MetricsRegistry:
                   buckets: Optional[Sequence[float]] = None,
                   max_samples: int = 65536,
                   labels: Optional[dict] = None) -> Histogram:
-        key = labeled_name(name, labels)
-        with self._lock:
-            m = self._metrics.get(key)
-            if m is None:
-                m = Histogram(name, help, buckets=buckets,
-                              max_samples=max_samples, labels=labels)
-                self._metrics[key] = m
-            elif not isinstance(m, Histogram):
-                raise TypeError(
-                    f"metric {key!r} already registered as "
-                    f"{type(m).__name__}")
-            return m
+        return self._get(name, Histogram, help, labels,
+                         buckets=buckets, max_samples=max_samples)
 
-    def _get(self, name, cls, help, labels=None):
+    def _get(self, name, cls, help, labels=None, **kwargs):
+        """THE create-or-return path — every metric type goes through
+        this one lock-held lookup, so two call sites (or two threads)
+        registering the same (name, labels) always share one object
+        and a type mismatch raises instead of forking twin series
+        [ISSUE 12 satellite]."""
         key = labeled_name(name, labels)
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
-                m = cls(name, help, labels=labels)
+                m = cls(name, help, labels=labels, **kwargs)
                 self._metrics[key] = m
             elif not isinstance(m, cls):
                 raise TypeError(
