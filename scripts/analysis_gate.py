@@ -1,14 +1,22 @@
-"""CI leg for the static invariant checkers [ISSUE 12]: run
-``tuplewise check`` in-process, write the JSON report artifact, and
-fail on any unwaived finding, waiver-file error, parse error, or
-import cycle.
+"""CI leg for the static invariant checkers [ISSUE 12, dataflow tier
+ISSUE 13]: run ``tuplewise check`` in-process, write the JSON report
+artifact (and optionally SARIF for inline PR annotations), diff the
+overflow certificate against the committed baseline, and fail on any
+unwaived finding, waiver-file error, parse error, import cycle, or
+certificate drift.
 
-The ratchet lives in the waiver semantics themselves (each waiver
-absorbs a bounded count — see analysis/waivers.py), so this gate has
-no separate baseline file to drift: a new violation anywhere fails
-even where old waived ones exist.
+The finding ratchet lives in the waiver semantics (each waiver
+absorbs a bounded count — analysis/waivers.py). The overflow
+certificate HAS a baseline by design
+(``tuplewise_tpu/analysis/exactness_bounds.toml``): the bound table
+is a function of the compile-ladder maxima, so a ladder bump that
+breaks int32 safety must fail with the violating bound NAMED — that
+requires committing the expected bounds, not just "no new findings".
 
-Usage: python scripts/analysis_gate.py [--out results/analysis_report.json]
+Usage: python scripts/analysis_gate.py
+           [--out results/analysis_report.json]
+           [--sarif results/analysis_report.sarif]
+           [--no-cache]
 """
 
 from __future__ import annotations
@@ -21,28 +29,137 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+BASELINE = os.path.join(
+    REPO, "tuplewise_tpu", "analysis", "exactness_bounds.toml")
+
+_SARIF_RULE_HELP = {
+    "race-unguarded-shared":
+        "attribute shared across thread roles with an unguarded "
+        "access",
+    "race-inconsistent-guard":
+        "attribute shared across thread roles with no common guard "
+        "lock",
+    "count-float-taint":
+        "float-tainted value flows into an integer win-count "
+        "accumulator",
+    "count-narrow-accumulator":
+        "raw int32 device value accumulated without widening",
+    "overflow-int32":
+        "int32 accumulator bound exceeds 2^31-1 at ladder maxima",
+    "overflow-unproved":
+        "int32 accumulator the overflow classifier cannot bound",
+}
+
+
+def to_sarif(report: dict) -> dict:
+    """SARIF 2.1.0 — one run, one result per finding (waived findings
+    ride along at 'note' level with a suppression record, so the PR
+    annotation layer shows them greyed out, not red)."""
+    rules = {}
+    results = []
+
+    def add(f: dict, level: str, suppressed: bool,
+            reason: str = "") -> None:
+        rid = f["rule"]
+        rules.setdefault(rid, {
+            "id": rid,
+            "shortDescription": {
+                "text": _SARIF_RULE_HELP.get(
+                    rid, f"tuplewise invariant rule {rid}")},
+        })
+        res = {
+            "ruleId": rid,
+            "level": level,
+            "message": {"text": f["message"]},
+            "partialFingerprints": {
+                "tuplewiseFingerprint/v1": f["fingerprint"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f["file"],
+                        "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(int(f["line"]), 1)},
+                }}],
+        }
+        if suppressed:
+            res["suppressions"] = [{
+                "kind": "external",
+                "justification": reason or "waived in "
+                "tuplewise_tpu/analysis/waivers.toml"}]
+        results.append(res)
+
+    for f in report["findings"]:
+        add(f, "error", suppressed=False)
+    for f in report.get("waived", ()):
+        add(f, "note", suppressed=True, reason=f.get("reason", ""))
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                    ".json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tuplewise-check",
+                "informationUri":
+                    "docs/DESIGN.md#17-static-invariant-checks",
+                "rules": sorted(rules.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", type=str,
                     default=os.path.join(REPO, "results",
                                          "analysis_report.json"))
+    ap.add_argument("--sarif", type=str, default=None,
+                    help="also write a SARIF 2.1.0 report here "
+                         "(uploaded next to the JSON so findings "
+                         "render as inline PR annotations)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the content-sha parse cache")
     args = ap.parse_args(argv)
 
+    from tuplewise_tpu.analysis import exactness
     from tuplewise_tpu.analysis.runner import run_checks
 
-    report = run_checks(root=REPO)
+    report = run_checks(root=REPO, use_cache=not args.no_cache)
+
+    # overflow-certificate baseline diff [ISSUE 13 satellite]: the
+    # derived bound table must match the committed envelope exactly
+    cert_errors = []
+    if os.path.exists(BASELINE):
+        with open(BASELINE, "r", encoding="utf-8") as f:
+            cert_errors = exactness.compare_to_baseline(
+                report["overflow_certificate"], f.read())
+    else:
+        cert_errors = [f"missing committed baseline {BASELINE}"]
+    report["certificate_diff"] = cert_errors
+    if cert_errors:
+        report["ok"] = False
+
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=2)
+    if args.sarif:
+        os.makedirs(os.path.dirname(args.sarif) or ".", exist_ok=True)
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(to_sarif(report), f, indent=2)
 
     s = report["summary"]
+    c = s["cache"]
     print(f"ANALYSIS GATE: {s['files_analyzed']} files, "
           f"{s['findings_total']} findings "
           f"({s['waived']} waived, {s['unwaived']} unwaived), "
           f"{len(report['import_cycles'])} import cycles, "
           f"{len(report['dead_symbols'])} dead public symbols "
-          f"(warn-only)", file=sys.stderr)
+          f"(warn-only), cache {c['hits']}/{c['hits'] + c['misses']} "
+          f"hits, certificate "
+          f"{'OK' if not cert_errors else 'DRIFT'}", file=sys.stderr)
     for f_ in report["findings"]:
         print(f"  UNWAIVED {f_['rule']}: {f_['file']}:{f_['line']} "
               f"[{f_['symbol']}] {f_['message']}", file=sys.stderr)
@@ -53,12 +170,16 @@ def main(argv=None) -> int:
         print(f"  stale waiver: {w['rule']} {w['file']} "
               f"[{w['symbol']}] (waivers.toml:{w['line']})",
               file=sys.stderr)
+    for e in cert_errors:
+        print(f"  CERTIFICATE: {e}", file=sys.stderr)
     # one machine-readable verdict line on stdout (the doctor/perf-gate
     # convention: tail -n 1 | json)
     print(json.dumps({"stage": "analysis_gate", "ok": report["ok"],
                       "unwaived": s["unwaived"],
                       "waived": s["waived"],
-                      "unused_waivers": s["waivers_unused"]}))
+                      "unused_waivers": s["waivers_unused"],
+                      "certificate_ok": not cert_errors,
+                      "cache_hits": c["hits"]}))
     if not report["ok"]:
         print("ANALYSIS GATE FAIL (report in "
               f"{args.out})", file=sys.stderr)

@@ -8,14 +8,19 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-# Static invariant checks [ISSUE 12] — FIRST, because they need no
-# jax and fail in seconds: lock-order/thread discipline, traced-code
-# purity, telemetry cross-reference, compile-ladder discipline,
-# config/CLI/doc drift, import cycles. Findings are suppressible only
-# via the committed tuplewise_tpu/analysis/waivers.toml (bounded
-# per-waiver counts = the ratchet); the JSON report lands at
-# results/analysis_report.json for the CI artifact.
-timeout -k 10 120 python scripts/analysis_gate.py
+# Static invariant checks [ISSUE 12, dataflow tier ISSUE 13] —
+# FIRST, because they need no jax and fail in seconds: lock-order/
+# thread discipline, traced-code purity, telemetry cross-reference,
+# compile-ladder discipline (flow-sensitive), config/CLI/doc drift,
+# guard-inference race detection, integer-exactness + int32 overflow
+# certification (diffed against the committed
+# analysis/exactness_bounds.toml envelope), import cycles. Findings
+# are suppressible only via the committed
+# tuplewise_tpu/analysis/waivers.toml (bounded per-waiver counts =
+# the ratchet); the JSON report lands at results/analysis_report.json
+# and the SARIF twin (inline PR annotations) next to it.
+timeout -k 10 180 python scripts/analysis_gate.py \
+    --sarif results/analysis_report.sarif
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 

@@ -532,7 +532,8 @@ def test_repo_every_pass_ran(repo_report):
     per_pass = repo_report["summary"]["per_pass"]
     assert set(per_pass) == {"lock-order", "traced-purity",
                              "telemetry-xref", "compile-ladder",
-                             "config-drift", "module-graph"}
+                             "config-drift", "races", "exactness",
+                             "module-graph"}
     # the waived findings prove the passes bite on the real tree
     assert repo_report["summary"]["waived"] > 0
 

@@ -25,6 +25,22 @@ a fail-mode CI leg (``scripts/analysis_gate.py``):
                         fields <-> CLI flags <-> README/DESIGN mentions
                         must agree.
 
+The flow-sensitive dataflow tier [ISSUE 13] rides on
+``analysis/dataflow.py`` (interprocedural call graph + forward
+abstract interpretation — the replacement for the one-assignment
+chase):
+
+* ``races``           — RacerD-style guard inference: per thread role
+                        (batcher/compactor/reaper/...), attributes
+                        reachable from >= 2 roles that are accessed
+                        unguarded or under inconsistent locks, with
+                        the access-site evidence chain.
+* ``exactness``       — int-lattice proof that no float taints a
+                        wins2 accumulator, plus the int32 overflow
+                        certificate (worst-case bounds at the
+                        compile-ladder maxima, diffed in CI against
+                        the committed exactness_bounds.toml).
+
 Findings are suppressible ONLY via the committed, per-finding-justified
 waiver file (``analysis/waivers.toml``); each waiver absorbs a bounded
 count of findings, so NEW violations fail even where old waived ones

@@ -1,0 +1,102 @@
+"""Incremental analysis cache [ISSUE 13 satellite].
+
+``tuplewise check`` re-parses ~100 modules per run; CI runs it on
+every push. Parsed modules are immutable functions of their source
+bytes, so they cache perfectly: each file's :class:`ModuleInfo`
+(AST + index tables) is pickled under its content sha — a repeat run
+reparses ONLY changed files and the report carries the hit/miss
+counters. ``--no-cache`` is the escape hatch; the cache directory
+(``.tuplewise_check_cache/``, gitignored) is safe to delete at any
+time.
+
+Keys include an ``ANALYSIS_CACHE_VERSION`` stamp and the Python
+version: bumping the version whenever ``core.ModuleInfo``'s shape
+changes invalidates every stale entry at once — a wrong hit can never
+outlive the code that wrote it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import sys
+from typing import Optional
+
+#: bump when core.ModuleInfo's pickled shape changes
+ANALYSIS_CACHE_VERSION = 1
+
+DEFAULT_CACHE_DIR = ".tuplewise_check_cache"
+
+
+def _stamp() -> str:
+    return (f"v{ANALYSIS_CACHE_VERSION}-py{sys.version_info[0]}."
+            f"{sys.version_info[1]}")
+
+
+class ParseCache:
+    """Content-sha keyed store of pickled ModuleInfo objects. One file
+    per module path (sha inside), so stale entries replace themselves
+    and the directory never grows past the corpus size."""
+
+    def __init__(self, root: str,
+                 subdir: str = DEFAULT_CACHE_DIR):
+        self.dir = os.path.join(root, subdir)
+        self.hits = 0
+        self.misses = 0
+        self._ready = False
+
+    def _ensure_dir(self) -> bool:
+        if not self._ready:
+            try:
+                os.makedirs(self.dir, exist_ok=True)
+                self._ready = True
+            except OSError:
+                return False
+        return True
+
+    @staticmethod
+    def key(path: str, source: str) -> str:
+        h = hashlib.sha256()
+        h.update(_stamp().encode())
+        h.update(path.encode())
+        h.update(source.encode())
+        return h.hexdigest()
+
+    def _entry_path(self, path: str) -> str:
+        safe = path.replace("/", "__").replace("\\", "__")
+        return os.path.join(self.dir, safe + ".pkl")
+
+    def get(self, path: str, source: str):
+        """The cached ModuleInfo for (path, source), or None."""
+        try:
+            with open(self._entry_path(path), "rb") as f:
+                sha, mi = pickle.load(f)
+        except (OSError, pickle.PickleError, EOFError,
+                AttributeError, ImportError):
+            self.misses += 1
+            return None
+        if sha != self.key(path, source):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return mi
+
+    def put(self, path: str, source: str, mi) -> None:
+        if not self._ensure_dir():
+            return
+        tmp = self._entry_path(path) + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump((self.key(path, source), mi), f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._entry_path(path))
+        except (OSError, pickle.PickleError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        return {"enabled": True, "hits": self.hits,
+                "misses": self.misses}

@@ -1,4 +1,5 @@
-"""Pass 4 — compile-ladder discipline [ISSUE 12].
+"""Pass 4 — compile-ladder discipline [ISSUE 12, flow-sensitive
+rework ISSUE 13].
 
 The XLA/Pallas compile-cache stays bounded ONLY because every shape a
 jitted count function is built for comes off the power-of-two bucket
@@ -9,13 +10,34 @@ returned callable is jitted (``_jit_count_fn``, ``sharded_count_fn``,
 compiled-shape universe — on its integer arguments.
 
 Rule ``ladder-raw-shape``: at any call site of such a factory, a
-shape-determining argument whose expression derives directly from
-``len(...)`` / ``.shape`` / ``.size`` without passing through a bucket
-helper (``next_bucket`` / ``_next_bucket`` / ``_t_bucket``) compiles
-one program per distinct live size — unbounded cache growth and a
-recompile storm under churn. One level of local assignment is chased:
-``qb = len(q)`` then ``f(qb)`` is flagged; ``qb = next_bucket(len(q))``
-is clean.
+shape-determining argument whose value derives from ``len(...)`` /
+``.shape`` / ``.size`` of an arbitrary array compiles one program per
+distinct live size — unbounded cache growth and a recompile storm
+under churn.
+
+PR 12's version chased ONE local assignment; this version evaluates
+the argument on the shared dataflow substrate (``analysis.dataflow``)
+with a ladder lattice:
+
+* ``next_bucket`` / ``_next_bucket`` / ``_t_bucket`` /
+  ``tenant_bucket`` results are **bucketed**, as are integer
+  constants and min/max/arithmetic over bucketed values;
+* arrays allocated with bucketed dimensions (``np.full(bb, ...)``,
+  ``np.zeros((t_bucket, qb))``) — and arrays RETURNED by a
+  ladder-compiled ``*_fn(...)(...)`` factory call, whose shapes are
+  ladder-derived by induction — are **ladder arrays**: their
+  ``len()`` / ``.shape`` / ``.size`` reads are the ladder value
+  itself, not a raw size;
+* the chase is interprocedural: parameters take the JOIN of their
+  resolved call-site values (a query block every caller pads to its
+  bucket proves the callee's ``.shape`` read clean), and constructor
+  fields flow through NamedTuples (``plan.pos`` is the
+  ``next_bucket``-padded array ``plan_major_merge`` built).
+
+This is precision the PR 12 waivers papered over: the
+``sharded_major_merge`` / ``tenant_pack_counts`` bucketed-shape
+entries are gone from ``waivers.toml`` because the checker now PROVES
+them on-ladder [ISSUE 13 satellite].
 """
 
 from __future__ import annotations
@@ -26,9 +48,19 @@ from typing import Dict, List, Optional, Set
 from tuplewise_tpu.analysis.core import (
     Finding, ModuleSet, call_name, dotted,
 )
+from tuplewise_tpu.analysis import dataflow
 
 _BUCKET_HELPERS = {"next_bucket", "_next_bucket", "_t_bucket",
-                   "self._t_bucket"}
+                   "tenant_bucket", "self._t_bucket"}
+
+# lattice values (hashable strings; dataflow.Domain contract)
+BUCKETED = "bucketed"        # on the ladder (or a plain constant)
+RAW = "raw"                  # derived from an arbitrary len/.shape
+ARR_LADDER = "arr_ladder"    # array with ladder-derived dimensions
+SHAPE_LADDER = "shape_ladder"  # .shape of a ladder array
+SHAPE_RAW = "shape_raw"      # .shape of anything else
+
+_ALLOC_LEAVES = {"zeros", "full", "empty", "ones"}
 
 
 def _is_lru(node: ast.AST) -> bool:
@@ -41,6 +73,110 @@ def _is_lru(node: ast.AST) -> bool:
                     "functools.cache", "cache"):
             return True
     return False
+
+
+class LadderDomain(dataflow.Domain):
+    """raw dominates (a maybe-raw shape is a finding); bucketed and
+    constants are interchangeable for cache-boundedness."""
+
+    top = None
+
+    def join(self, a, b):
+        if a == b:
+            return a
+        if a is None or b is None:
+            return None if RAW not in (a, b) else RAW
+        if RAW in (a, b) or SHAPE_RAW in (a, b):
+            return RAW
+        if {a, b} <= {BUCKETED, ARR_LADDER, SHAPE_LADDER}:
+            return BUCKETED if BUCKETED in (a, b) else a
+        return None
+
+    def const(self, value):
+        if isinstance(value, bool):
+            return None
+        if isinstance(value, int):
+            return BUCKETED     # a literal shape is one cache entry
+        return None
+
+    def call(self, cn, node, argvals, kwvals, recv=None):
+        leaf = cn.split(".")[-1] if cn else None
+        if cn in _BUCKET_HELPERS or leaf in _BUCKET_HELPERS:
+            return BUCKETED
+        if cn == "len":
+            v = argvals[0] if argvals else None
+            return BUCKETED if v == ARR_LADDER else RAW
+        if cn == "int" or leaf in ("int32", "int64"):
+            return argvals[0] if argvals else None
+        if cn in ("min", "max"):
+            out = BUCKETED
+            for v in argvals:
+                if v == RAW:
+                    return RAW
+                if v is None:
+                    out = None
+            return out
+        if leaf in _ALLOC_LEAVES:
+            shape = argvals[0] if argvals else None
+            if isinstance(shape, dataflow.Seq):
+                vals = set(shape.elts)
+                if vals <= {BUCKETED}:
+                    return ARR_LADDER
+                if RAW in vals:
+                    return None
+                return None
+            if shape == BUCKETED:
+                return ARR_LADDER
+            return None
+        if leaf in ("concatenate", "stack", "hstack", "sort",
+                    "ascontiguousarray"):
+            v = argvals[0] if argvals else None
+            if isinstance(v, dataflow.Seq):
+                if set(v.elts) <= {ARR_LADDER}:
+                    return ARR_LADDER
+                return None
+            return v if v in (ARR_LADDER,) else None
+        if leaf in ("asarray", "copy", "ravel", "reshape",
+                    "astype"):
+            if recv in (ARR_LADDER,):
+                return recv
+            v = argvals[0] if argvals else None
+            return v if v == ARR_LADDER else None
+        # calling the value a ladder factory returned: the result of a
+        # ladder-compiled program has ladder shapes by induction
+        if cn is None and isinstance(node.func, ast.Call):
+            inner = call_name(node.func)
+            if inner and inner.split(".")[-1].endswith("_fn"):
+                return ARR_LADDER
+        return None
+
+    def attribute(self, base, attr):
+        if attr in ("shape",):
+            return SHAPE_LADDER if base == ARR_LADDER else SHAPE_RAW
+        if attr in ("size",):
+            return BUCKETED if base == ARR_LADDER else RAW
+        return None
+
+    def subscript(self, base, index):
+        if base == SHAPE_LADDER:
+            return BUCKETED
+        if base == SHAPE_RAW:
+            return RAW
+        if base == ARR_LADDER:
+            return None
+        return None
+
+    def binop(self, op, left, right):
+        if RAW in (left, right):
+            return RAW
+        if left is None or right is None:
+            return None
+        if BUCKETED in (left, right):
+            return BUCKETED
+        return None
+
+    def sequence(self, node, elts):
+        return self.top
 
 
 def ladder_factories(ms: ModuleSet) -> Dict[str, Set[int]]:
@@ -67,71 +203,62 @@ def ladder_factories(ms: ModuleSet) -> Dict[str, Set[int]]:
     return out
 
 
-def _raw_shape(expr: ast.AST) -> Optional[str]:
-    """The offending sub-expression when ``expr`` derives a raw size,
-    ignoring anything wrapped in a bucket helper."""
+def _raw_label(expr: ast.AST) -> str:
+    """Human label of the offending derivation for the message."""
     for node in ast.walk(expr):
-        if isinstance(node, ast.Call):
-            cn = call_name(node)
-            if cn in _BUCKET_HELPERS or (
-                    cn and cn.split(".")[-1] in _BUCKET_HELPERS):
-                # prune: children of a bucket call are sanctioned.
-                # ast.walk can't prune, so check containment instead.
-                sanctioned = set(ast.walk(node))
-                return _raw_shape_outside(expr, sanctioned)
-    return _raw_shape_outside(expr, set())
-
-
-def _raw_shape_outside(expr: ast.AST, sanctioned) -> Optional[str]:
-    for node in ast.walk(expr):
-        if node in sanctioned:
-            continue
         if isinstance(node, ast.Call) and call_name(node) == "len":
             return "len(...)"
         if isinstance(node, ast.Attribute) and node.attr in ("shape",
                                                              "size"):
             return f".{node.attr}"
-    return None
+    return "len(...)/.shape"
 
 
 def run(ms: ModuleSet) -> List[Finding]:
     factories = ladder_factories(ms)
+    engine = dataflow.Engine(ms, LadderDomain())
     findings: List[Finding] = []
-    for path, mi in ms.modules.items():
-        for fi in mi.iter_functions():
-            # local one-level assignment map: name -> value expr
-            assigns: Dict[str, ast.AST] = {}
-            for node in ast.walk(fi.node):
-                if isinstance(node, ast.Assign) \
-                        and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name):
-                    assigns[node.targets[0].id] = node.value
-            for node in ast.walk(fi.node):
-                if not isinstance(node, ast.Call):
+
+    for key, node in engine.graph.functions.items():
+        path, cls, qual = key
+        if not any(isinstance(sub, ast.Call)
+                   and (lambda c: c and c.split(".")[-1]
+                        in factories)(call_name(sub))
+                   for sub in ast.walk(node)):
+            continue
+        calls: List[tuple] = []
+
+        def hook(walker, st, _calls=calls):
+            for sub in ast.walk(st):
+                if not isinstance(sub, ast.Call):
                     continue
-                cn = call_name(node)
+                cn = call_name(sub)
                 leaf = cn.split(".")[-1] if cn else None
                 if leaf not in factories:
                     continue
-                # skip the factory's own definition module self-call?
-                # no — a raw-size call inside the defining module is
-                # exactly as wrong as anywhere else.
-                for i, arg in enumerate(node.args):
+                for i, arg in enumerate(sub.args):
                     if i not in factories[leaf]:
                         continue
-                    expr = arg
-                    label = ast.dump(arg)[:0]  # unused; keep expr
-                    if isinstance(arg, ast.Name) \
-                            and arg.id in assigns:
-                        expr = assigns[arg.id]
-                    bad = _raw_shape(expr)
-                    if bad is not None:
-                        findings.append(Finding(
-                            "ladder-raw-shape", path, node.lineno,
-                            f"{fi.qualname}::{leaf}:{i}",
-                            f"{fi.qualname} passes a raw {bad}-derived"
-                            f" size as shape arg {i} of {leaf}() — "
-                            "shape-determining values must come off "
-                            "the bucket ladder (next_bucket) or XLA "
-                            "compiles one program per live size"))
-    return findings
+                    val = walker.eval(arg)
+                    if val == RAW:
+                        _calls.append((leaf, i, arg, sub.lineno))
+
+        engine.trace_function(key, hook)
+        for leaf, i, arg, lineno in calls:
+            findings.append(Finding(
+                "ladder-raw-shape", path, lineno,
+                f"{qual}::{leaf}:{i}",
+                f"{qual} passes a raw {_raw_label(arg)}-derived"
+                f" size as shape arg {i} of {leaf}() — "
+                "shape-determining values must come off "
+                "the bucket ladder (next_bucket) or XLA "
+                "compiles one program per live size"))
+
+    # dedupe by fingerprint (a loop can hit the same site twice)
+    seen: Set[str] = set()
+    out: List[Finding] = []
+    for f in findings:
+        if f.fingerprint not in seen:
+            seen.add(f.fingerprint)
+            out.append(f)
+    return out

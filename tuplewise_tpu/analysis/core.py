@@ -224,7 +224,8 @@ class ModuleSet:
     def from_repo(cls, root: str,
                   globs: Tuple[str, ...] = DEFAULT_GLOBS,
                   text_files: Tuple[str, ...] = (
-                      "README.md", "docs/DESIGN.md")) -> "ModuleSet":
+                      "README.md", "docs/DESIGN.md"),
+                  cache=None) -> "ModuleSet":
         sources: Dict[str, str] = {}
         for pat in globs:
             base = pat.split("*")[0].rstrip("/")
@@ -250,7 +251,25 @@ class ModuleSet:
             p = os.path.join(root, tf)
             if os.path.exists(p):
                 texts[tf] = _read(p)
-        ms = cls.from_sources(sources, texts=texts)
+        if cache is None:
+            ms = cls.from_sources(sources, texts=texts)
+        else:
+            # incremental parse [ISSUE 13 satellite]: content-sha hits
+            # skip the parse+index entirely; misses are stored back
+            mods: Dict[str, ModuleInfo] = {}
+            errors: Dict[str, str] = {}
+            for path, src in sources.items():
+                mi = cache.get(path, src)
+                if mi is None:
+                    try:
+                        mi = ModuleInfo(path, src)
+                    except SyntaxError as e:
+                        errors[path] = repr(e)
+                        continue
+                    cache.put(path, src, mi)
+                mods[path] = mi
+            ms = cls(mods, texts=texts)
+            ms.parse_errors = errors
         ms.root = root
         return ms
 

@@ -1,0 +1,665 @@
+"""Pass — integer-exactness + int32 overflow certification
+[ISSUE 13 tentpole].
+
+Every bit-identity claim in this repo (sharded vs single-host, kernel
+vs XLA, fleet vs independents) rests on ONE invariant the other passes
+cannot see: win counts stay on an integer path end-to-end, so psum'd
+per-shard sums are exact. Two analyses enforce it:
+
+**1. Float-taint of the wins2 accumulators** (int lattice over the
+dataflow substrate). An abstract interpretation chases every value
+through assignments, calls, returns and attribute reads with the
+lattice
+
+    pyint  — Python int (arbitrary precision: the wins2 contract)
+    int    — int64-family host integer (np.searchsorted, .astype(i64))
+    int32  — device-width integer (jnp results, .astype(int32))
+    float  — float-tainted (float literals, true division, np
+             default-dtype constructors, .astype(float), 0.5 * x)
+
+and judges every store/augmented-store into a ``*wins2*`` attribute:
+
+* ``count-float-taint``        — a float-tainted value flows into a
+  wins2 accumulator: the statistic silently stops being exact.
+* ``count-narrow-accumulator`` — a raw int32 device value flows in
+  without widening (``int()`` / ``.astype(np.int64)``): host
+  accumulation inherits the device width and can wrap.
+
+**2. Static overflow certification of int32 device accumulators.**
+Every ``@lru_cache`` jit/Pallas factory whose compiled body
+accumulates int32 counts is classified structurally (psum present?
+run-tuple/run-loop multiplicity? additive rank arithmetic? planned
+positions with the int32 sentinel?) and gets a symbolic worst-case
+bound in terms of the compile-ladder maxima (S, cap, q_bucket,
+t_bucket, max_runs). The evaluated per-accumulator bound table is the
+machine-readable **overflow certificate** (report key
+``overflow_certificate``; committed baseline
+``analysis/exactness_bounds.toml`` — the CI gate diffs them, so a
+ladder-maximum bump that breaks int32 safety fails with the violating
+bound named). A factory the classifier cannot bound is a finding
+(``overflow-unproved``), as is a bound exceeding 2^31 − 1 at the
+declared maxima (``overflow-int32``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tuplewise_tpu.analysis.core import (
+    Finding, ModuleSet, call_name, dotted,
+)
+from tuplewise_tpu.analysis import dataflow
+
+INT32_LIMIT = 2 ** 31 - 1
+
+#: The certified compile-ladder envelope. These are the maxima the
+#: ladders are allowed to reach (DESIGN §17); the committed baseline
+#: (analysis/exactness_bounds.toml) must declare the SAME values, and
+#: the CI gate re-derives every bound from them — bump one here or
+#: there without the other and the gate fails.
+DEFAULT_MAXIMA: Dict[str, int] = {
+    "S": 256,            # mesh width
+    "cap": 2 ** 21,      # per-shard row bucket (base/delta/tomb caps)
+    "q_bucket": 2 ** 16,  # query-block bucket
+    "t_bucket": 2 ** 16,  # tenant-slot bucket
+    "max_runs": 3,       # signed runs per side: base + delta + tomb
+}
+
+# factory parameter name -> maxima key ("cap-like" params bound the
+# searched run length; q/t buckets bound their own axes)
+_PARAM_MAXIMA = (
+    ("t_bucket", "t_bucket"),
+    ("q_bucket", "q_bucket"),
+    ("qb", "q_bucket"),
+    ("cap", "cap"),         # cap, caps, cap_pos, cap_base, delta_cap...
+    ("bucket", "cap"),      # base_bucket, bucket
+    ("chunk", "cap"),
+)
+
+
+# --------------------------------------------------------------------- #
+# int lattice                                                            #
+# --------------------------------------------------------------------- #
+
+PYINT = "pyint"
+INT = "int"        # int64-family host value/array
+INT32 = "int32"    # device-width integer
+FLOAT = "float"    # float-tainted
+
+_INTS = (PYINT, INT, INT32)
+
+_INT64_CTORS = {"np.searchsorted", "numpy.searchsorted"}
+_INT32_CTORS = {"jnp.searchsorted", "jax.numpy.searchsorted"}
+_FLOAT_CTORS = {"np.zeros", "np.ones", "np.full", "np.empty",
+                "jnp.zeros", "jnp.ones", "jnp.full",
+                "np.linspace", "jnp.linspace"}
+_SHAPE_PRESERVING = {"ravel", "reshape", "copy", "flatten",
+                     "squeeze", "transpose", "clip"}
+
+
+def _dtype_value(node: Optional[ast.AST]) -> Optional[str]:
+    """Lattice value named by a dtype expression, if recognizable."""
+    if node is None:
+        return None
+    d = dotted(node)
+    if d is None:
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            d = node.value
+        else:
+            return None
+    leaf = d.split(".")[-1]
+    if leaf in ("int64", "intp", "int_"):
+        return INT
+    if leaf in ("int32", "int16", "int8"):
+        return INT32
+    if leaf in ("float16", "float32", "float64", "bfloat16", "float"):
+        return FLOAT
+    if leaf == "int":
+        return PYINT
+    return None
+
+
+class IntDomain(dataflow.Domain):
+    """The integer-exactness lattice. ``top`` = unknown (NOT tainted:
+    the pass under-approximates rather than spraying false floats)."""
+
+    top = None
+
+    def join(self, a, b):
+        if a == b:
+            return a
+        if a is None or b is None:
+            return None
+        if FLOAT in (a, b):
+            return FLOAT
+        if INT32 in (a, b):
+            return INT32
+        return INT
+
+    def const(self, value):
+        if isinstance(value, bool):
+            return PYINT
+        if isinstance(value, int):
+            return PYINT
+        if isinstance(value, float):
+            return FLOAT
+        return None
+
+    def binop(self, op, left, right):
+        if isinstance(op, ast.Div):
+            return FLOAT
+        if left is None and right is None:
+            return None
+        if FLOAT in (left, right):
+            return FLOAT
+        if left is None or right is None:
+            return None
+        if INT32 in (left, right):
+            return INT32
+        if left == PYINT and right == PYINT:
+            return PYINT
+        return INT
+
+    def call(self, cn, node, argvals, kwvals, recv=None):
+        if cn is None:
+            return None
+        leaf = cn.split(".")[-1]
+        if cn == "len":
+            return PYINT
+        if cn == "int":
+            return PYINT
+        if cn == "float":
+            return FLOAT
+        if cn in _INT64_CTORS:
+            return INT
+        if cn in _INT32_CTORS:
+            return INT32
+        if leaf == "astype":
+            v = _dtype_value(node.args[0]) if node.args else \
+                _dtype_value(next((k.value for k in node.keywords
+                                   if k.arg == "dtype"), None))
+            return v
+        if cn in _FLOAT_CTORS or leaf in ("arange", "asarray",
+                                          "array", "zeros", "full",
+                                          "ones", "empty"):
+            v = _dtype_value(next(
+                (k.value for k in node.keywords if k.arg == "dtype"),
+                None))
+            if v is not None:
+                return v
+            if cn in _FLOAT_CTORS:
+                return FLOAT     # numpy default dtype is float64
+            return None
+        if leaf in ("sum", "cumsum", "prod", "max", "min", "dot"):
+            return recv
+        if leaf in _SHAPE_PRESERVING:
+            return recv
+        if leaf in ("searchsorted",):
+            # method form: arr.searchsorted(...) — host numpy
+            return INT
+        if leaf in ("mean", "std", "var", "item"):
+            return FLOAT if leaf != "item" else recv
+        if leaf in ("concatenate", "stack", "hstack", "vstack",
+                    "where", "sort"):
+            vals = [v for v in argvals if v is not None]
+            if len(argvals) == 1 and isinstance(argvals[0],
+                                                dataflow.Seq):
+                vals = [v for v in argvals[0].elts if v is not None]
+            out = None
+            for v in vals:
+                out = v if out is None else self.join(out, v)
+            return out
+        return None
+
+    def attribute(self, base, attr):
+        if attr == "size":
+            return PYINT
+        return None
+
+    def subscript(self, base, index):
+        # an element/slice of an int array is int-family; of a float
+        # array float — the array value IS the element value here
+        return base
+
+    def unaryop(self, op, operand):
+        return operand
+
+
+# --------------------------------------------------------------------- #
+# float-taint of the wins2 accumulators                                  #
+# --------------------------------------------------------------------- #
+
+def _is_wins2_target(node: ast.AST) -> Optional[str]:
+    d = dotted(node)
+    if d is None:
+        return None
+    leaf = d.split(".")[-1]
+    if "wins2" in leaf:
+        return d
+    return None
+
+
+def taint_findings(ms: ModuleSet,
+                   engine: Optional[dataflow.Engine] = None
+                   ) -> List[Finding]:
+    if engine is None:
+        engine = dataflow.Engine(ms, IntDomain())
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+
+    for key, node in engine.graph.functions.items():
+        path, cls, qual = key
+        hits: List[Tuple[str, int, Any]] = []
+
+        def hook(walker, st, _hits=hits):
+            target = value = None
+            if isinstance(st, ast.AugAssign):
+                target = _is_wins2_target(st.target)
+                if target is not None:
+                    value = walker.eval(st.value)
+            elif isinstance(st, ast.Assign) and len(st.targets) == 1:
+                target = _is_wins2_target(st.targets[0])
+                if target is not None:
+                    value = walker.eval(st.value)
+            if target is not None:
+                _hits.append((target, st.lineno, value))
+
+        has_wins2 = any(
+            isinstance(n, (ast.Assign, ast.AugAssign))
+            and _is_wins2_target(
+                n.target if isinstance(n, ast.AugAssign)
+                else n.targets[0] if len(n.targets) == 1 else n)
+            for n in ast.walk(node)
+            if isinstance(n, (ast.Assign, ast.AugAssign)))
+        if not has_wins2:
+            continue
+        engine.trace_function(key, hook)
+        for target, line, value in hits:
+            if value == FLOAT:
+                f = Finding(
+                    "count-float-taint", path, line,
+                    f"{qual}::{target}",
+                    f"{qual} stores a float-tainted value into the "
+                    f"integer win-count accumulator {target} — the "
+                    "statistic silently stops being exact (psum'd "
+                    "shard sums, kernel-vs-XLA parity and every "
+                    "bit-identity claim depend on the pure-integer "
+                    "path, DESIGN §15)")
+            elif value == INT32:
+                f = Finding(
+                    "count-narrow-accumulator", path, line,
+                    f"{qual}::{target}",
+                    f"{qual} accumulates a raw int32 device value "
+                    f"into {target} without widening — host "
+                    "accumulation inherits the device width and can "
+                    "wrap; widen with int() or .astype(np.int64) "
+                    "first")
+            else:
+                continue
+            if f.fingerprint not in seen:
+                seen.add(f.fingerprint)
+                findings.append(f)
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# int32 overflow certification                                           #
+# --------------------------------------------------------------------- #
+
+def _param_bound(name: str) -> Optional[str]:
+    low = name.lower()
+    for pat, key in _PARAM_MAXIMA:
+        if pat in low:
+            return key
+    return None
+
+
+def _factory_features(node: ast.AST) -> Dict[str, Any]:
+    """Structural features of one factory body that drive the bound
+    rules."""
+    feats = {"int32": False, "searchsorted": False, "psum": False,
+             "run_loop": False, "compare_count": False,
+             "axis_index": False, "adds": 0, "cumsum": False,
+             "planned_pos": False}
+    src_names: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            if sub.attr in ("int32", "int16"):
+                feats["int32"] = True
+        if isinstance(sub, ast.Name):
+            src_names.add(sub.id)
+        if isinstance(sub, ast.Call):
+            cn = call_name(sub) or ""
+            leaf = cn.split(".")[-1]
+            if leaf == "searchsorted":
+                feats["searchsorted"] = True
+            elif leaf == "psum":
+                feats["psum"] = True
+            elif leaf == "axis_index":
+                feats["axis_index"] = True
+            elif leaf == "cumsum":
+                feats["cumsum"] = True
+            elif leaf == "astype" and sub.args:
+                if _dtype_value(sub.args[0]) == INT32:
+                    feats["int32"] = True
+        if isinstance(sub, ast.For):
+            for inner in ast.walk(sub):
+                if isinstance(inner, ast.Call):
+                    lf = (call_name(inner) or "").split(".")[-1]
+                    if lf in ("searchsorted", "astype", "add"):
+                        feats["run_loop"] = True
+        if isinstance(sub, ast.Compare) and sub.ops \
+                and isinstance(sub.ops[0], (ast.Lt, ast.LtE)):
+            feats["compare_count"] = True
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Add):
+            feats["adds"] += 1
+    feats["planned_pos"] = "pos" in src_names and feats["cumsum"]
+    return feats
+
+
+def _expand_helpers(mi, node: ast.AST,
+                    params: List[str]) -> Tuple[List[ast.AST],
+                                                List[str]]:
+    """The factory body plus every same-module helper it reaches (the
+    Pallas builders put the kernel in ``_x_call``/``_x_kernel``
+    helpers, not the lru body) — features and parameter names are
+    judged over the union."""
+    nodes = [node]
+    names = list(params)
+    seen = {getattr(node, "name", "")}
+    frontier = [node]
+    for _depth in range(3):
+        nxt = []
+        for n in frontier:
+            for sub in ast.walk(n):
+                # callees AND bare references: the Pallas builders
+                # hand the kernel fn to functools.partial/pallas_call
+                # as an argument, not a call
+                if isinstance(sub, ast.Name):
+                    cands = (sub.id,)
+                elif isinstance(sub, ast.Call):
+                    cn = call_name(sub)
+                    cands = (cn, (cn or "").split(".")[0])
+                else:
+                    continue
+                for cand in cands:
+                    if cand and cand in mi.functions \
+                            and cand not in seen:
+                        helper = mi.functions[cand]
+                        seen.add(cand)
+                        nodes.append(helper)
+                        names.extend(a.arg
+                                     for a in helper.args.args)
+                        nxt.append(helper)
+        frontier = nxt
+    return nodes, names
+
+
+def _merge_features(nodes: List[ast.AST]) -> Dict[str, Any]:
+    feats: Optional[Dict[str, Any]] = None
+    for n in nodes:
+        f = _factory_features(n)
+        if feats is None:
+            feats = f
+        else:
+            for k, v in f.items():
+                if k == "adds":
+                    feats[k] += v
+                else:
+                    feats[k] = feats[k] or v
+    return feats or {}
+
+
+def _classify(name: str, node: ast.AST,
+              params: List[str],
+              feats: Optional[Dict[str, Any]] = None
+              ) -> Optional[Dict[str, Any]]:
+    """(category, symbolic bound terms) for one lru_cache factory, or
+    None when it has no int32 accumulator to certify."""
+    if feats is None:
+        feats = _factory_features(node)
+    # bare `<` comparisons are everywhere; only comparison COUNTING
+    # (compare + int32 accumulation) or searchsorted is count-shaped
+    counts = feats["searchsorted"] \
+        or (feats["compare_count"] and feats["int32"])
+    if not (feats["int32"] or counts):
+        return None
+    cap_keys = sorted({k for k in (
+        _param_bound(p) for p in params) if k is not None}
+        - {"q_bucket", "t_bucket"})
+    cap_key = cap_keys[0] if cap_keys else "cap"
+    has_runs_tuple = any(p in ("caps", "signs", "runs") or
+                         p.endswith("caps") for p in params)
+    if feats["planned_pos"]:
+        # rank arithmetic against host-planned positions: the int32
+        # padding sentinel (iinfo.max) is the worst-case magnitude BY
+        # DESIGN — planned ranks themselves stay <= S*cap
+        return {"category": "planned-rank",
+                "expr": "iinfo(int32).max sentinel (planned "
+                        "positions; ranks <= S*cap)",
+                "terms": [("const", INT32_LIMIT)]}
+    if counts:
+        terms: List[Tuple[str, Any]] = []
+        if feats["psum"]:
+            terms.append(("max", "S"))
+        if has_runs_tuple or feats["run_loop"]:
+            terms.append(("max", "max_runs"))
+        extra_adds = 0
+        if not (has_runs_tuple or feats["run_loop"]):
+            # additive index construction outside a run loop
+            # (jc + searchsorted(...)): each add contributes one more
+            # cap-bounded term
+            extra_adds = 1 if feats["cumsum"] else 0
+        terms.append(("max", cap_key))
+        cat = "psum-count" if feats["psum"] else "count"
+        return {"category": cat, "terms": terms,
+                "extra_terms": 1 + extra_adds}
+    # int32 without comparison counting: index/scatter arithmetic
+    # bounded by its widest bucket axis
+    axes = sorted({k for k in (_param_bound(p) for p in params)
+                   if k is not None})
+    if not axes:
+        return None
+    return {"category": "index",
+            "terms": [("max", a) for a in axes[:1]],
+            "extra_terms": 2 if feats["adds"] else 1}
+
+
+def certificates(ms: ModuleSet,
+                 maxima: Optional[Dict[str, int]] = None
+                 ) -> Dict[str, Any]:
+    """The overflow certificate: per-factory worst-case int32 bounds
+    at the compile-ladder maxima. ``{"maxima": ..., "bounds": [...],
+    "ok": bool}`` — each bound entry carries the factory, category,
+    symbolic expression, evaluated bound, and its verdict."""
+    from tuplewise_tpu.analysis.compile_ladder import _is_lru
+
+    maxima = dict(DEFAULT_MAXIMA if maxima is None else maxima)
+    entries: List[Dict[str, Any]] = []
+    unproved: List[Tuple[str, str, int]] = []
+    for path, mi in sorted(ms.modules.items()):
+        for fi in mi.iter_functions():
+            node = fi.node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not _is_lru(node):
+                continue
+            nodes, params = _expand_helpers(
+                mi, node, [a.arg for a in node.args.args])
+            feats = _merge_features(nodes)
+            cls = _classify(node.name, node, params, feats=feats)
+            if cls is None:
+                if feats.get("int32"):
+                    unproved.append((path, node.name, node.lineno))
+                continue
+            if cls["category"] == "planned-rank":
+                bound = INT32_LIMIT
+                expr = cls["expr"]
+            else:
+                bound = 1
+                parts = []
+                for kind, term in cls["terms"]:
+                    v = maxima.get(term, None)
+                    if v is None:
+                        unproved.append((path, node.name, node.lineno))
+                        bound = None
+                        break
+                    bound *= v
+                    parts.append(term)
+                if bound is None:
+                    continue
+                extra = cls.get("extra_terms", 1)
+                bound *= extra
+                expr = " * ".join(parts) + \
+                    (f" * {extra}" if extra > 1 else "")
+            entries.append({
+                "factory": node.name,
+                "file": path,
+                "line": node.lineno,
+                "category": cls["category"],
+                "expr": expr,
+                "bound": bound,
+                "ok": bound <= INT32_LIMIT,
+            })
+    entries.sort(key=lambda e: (e["file"], e["factory"]))
+    return {
+        "maxima": maxima,
+        "limit": INT32_LIMIT,
+        "bounds": entries,
+        "unproved": [{"file": p, "factory": f, "line": ln}
+                     for p, f, ln in sorted(unproved)],
+        "ok": all(e["ok"] for e in entries) and not unproved,
+    }
+
+
+def overflow_findings(cert: Dict[str, Any]) -> List[Finding]:
+    findings: List[Finding] = []
+    for e in cert["bounds"]:
+        if not e["ok"]:
+            findings.append(Finding(
+                "overflow-int32", e["file"], e["line"], e["factory"],
+                f"int32 accumulator in {e['factory']} has worst-case "
+                f"magnitude {e['bound']} ( = {e['expr']} at the "
+                "declared compile-ladder maxima) > 2^31-1 — shrink "
+                "the ladder envelope in analysis/exactness_bounds."
+                "toml or widen the accumulator to int64"))
+    for u in cert["unproved"]:
+        findings.append(Finding(
+            "overflow-unproved", u["file"], u["line"], u["factory"],
+            f"jit factory {u['factory']} builds int32 values the "
+            "overflow classifier cannot bound — add a rule (or "
+            "restructure the accumulator) so the certificate covers "
+            "it; an unbounded int32 accumulator is exactly how a "
+            "ladder bump corrupts counts silently"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# baseline file (committed envelope)                                     #
+# --------------------------------------------------------------------- #
+
+class BaselineError(ValueError):
+    """exactness_bounds.toml is malformed."""
+
+
+def parse_baseline(text: str) -> Dict[str, Any]:
+    """Parse the committed envelope: one ``[maxima]`` table plus
+    ``[[bound]]`` entries — the same deliberate TOML subset as
+    waivers.toml (no tomllib in this container)."""
+    maxima: Dict[str, int] = {}
+    bounds: List[Dict[str, Any]] = []
+    current: Optional[Dict[str, Any]] = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[maxima]":
+            current = maxima
+            continue
+        if line == "[[bound]]":
+            current = {}
+            bounds.append(current)
+            continue
+        if line.startswith("["):
+            raise BaselineError(
+                f"exactness_bounds.toml:{lineno}: only [maxima] and "
+                f"[[bound]] tables are supported, got {line!r}")
+        if "=" not in line or current is None:
+            raise BaselineError(
+                f"exactness_bounds.toml:{lineno}: expected "
+                f"'key = value' inside a table, got {line!r}")
+        key, _, val = line.partition("=")
+        key = key.strip()
+        val = val.strip()
+        if val.startswith('"') and val.endswith('"') and len(val) >= 2:
+            current[key] = val[1:-1]
+        elif val.lstrip("-").isdigit():
+            current[key] = int(val)
+        else:
+            raise BaselineError(
+                f"exactness_bounds.toml:{lineno}: value for {key!r} "
+                f"must be a string or integer, got {val!r}")
+    return {"maxima": maxima, "bounds": bounds}
+
+
+def compare_to_baseline(cert: Dict[str, Any],
+                        baseline_text: str) -> List[str]:
+    """Diff the freshly-derived certificate against the committed
+    envelope; returns human-readable violations (empty = in sync).
+    The gate fails CI on any entry — a ladder bump, a new unproved
+    factory, or a bound drift all land here with the bound NAMED."""
+    try:
+        base = parse_baseline(baseline_text)
+    except BaselineError as e:
+        return [str(e)]
+    errors: List[str] = []
+    if base["maxima"] != cert["maxima"]:
+        errors.append(
+            "ladder maxima drifted: committed "
+            f"{base['maxima']} vs derived {cert['maxima']} — "
+            "exactness_bounds.toml [maxima] and "
+            "analysis/exactness.DEFAULT_MAXIMA must move together")
+    by_key = {(b.get("file"), b.get("factory")): b
+              for b in base["bounds"]}
+    for e in cert["bounds"]:
+        k = (e["file"], e["factory"])
+        b = by_key.pop(k, None)
+        if b is None:
+            errors.append(
+                f"new int32 accumulator not in the committed "
+                f"envelope: {e['factory']} ({e['file']}) bound "
+                f"{e['bound']} — re-baseline after review")
+            continue
+        if int(b.get("bound", -1)) != int(e["bound"]):
+            errors.append(
+                f"bound drifted for {e['factory']} ({e['file']}): "
+                f"committed {b.get('bound')} vs derived {e['bound']} "
+                f"( = {e['expr']})")
+        if not e["ok"]:
+            errors.append(
+                f"int32 safety violated: {e['factory']} "
+                f"({e['file']}) worst-case {e['bound']} = "
+                f"{e['expr']} > 2^31-1")
+    for (path, fac) in sorted(k for k in by_key):
+        errors.append(
+            f"stale baseline entry: {fac} ({path}) no longer derived "
+            "— prune it from exactness_bounds.toml")
+    for u in cert["unproved"]:
+        errors.append(
+            f"unproved int32 factory: {u['factory']} ({u['file']})")
+    return errors
+
+
+# --------------------------------------------------------------------- #
+# the pass                                                               #
+# --------------------------------------------------------------------- #
+
+def run(ms: ModuleSet,
+        maxima: Optional[Dict[str, int]] = None) -> List[Finding]:
+    engine = dataflow.Engine(ms, IntDomain())
+    findings = taint_findings(ms, engine)
+    findings.extend(overflow_findings(certificates(ms, maxima)))
+    return findings

@@ -324,7 +324,11 @@ class _Analysis:
         return out
 
 
-def run(ms: ModuleSet) -> List[Finding]:
+def build_analysis(ms: ModuleSet) -> Tuple["_Analysis", List]:
+    """Shared setup for this pass AND the guard-inference pass
+    [ISSUE 13]: module-level lock identities, per-function scan
+    (acquisitions, blocking ops, resolved calls). Returns the
+    populated analysis plus the ``(path, FunctionInfo)`` list."""
     an = _Analysis(ms)
     # module-level locks
     for path, mi in ms.modules.items():
@@ -348,6 +352,11 @@ def run(ms: ModuleSet) -> List[Finding]:
             an.known_funcs.add((path, fi.cls or "", fi.qualname))
     for path, fi in funcs:
         an.scan_function(path, fi)
+    return an, funcs
+
+
+def run(ms: ModuleSet) -> List[Finding]:
+    an, funcs = build_analysis(ms)
 
     # transitive acquisitions and blocking ops
     acq_star = an.closure(

@@ -1,12 +1,20 @@
-"""``tuplewise check`` — run the five invariant passes + the module-
-graph report over the repo, apply the committed waiver file, and
-render one JSON report [ISSUE 12].
+"""``tuplewise check`` — run the invariant passes (five syntactic
+[ISSUE 12] + the flow-sensitive dataflow tier [ISSUE 13]: guard
+inference + integer-exactness/overflow certification) plus the
+module-graph report over the repo, apply the committed waiver file,
+and render one JSON report.
+
+The report also carries the **overflow certificate**
+(``overflow_certificate``: per-int32-accumulator worst-case bounds at
+the compile-ladder maxima) and the parse-cache counters (repeat runs
+reparse only changed files; ``--no-cache`` disables).
 
 Exit status: 0 = no unwaived findings (waived ones are listed, not
 fatal); 1 = at least one unwaived finding, a malformed waiver file, or
 (``--strict``) a stale waiver matching nothing. The CI leg
-(``scripts/analysis_gate.py``) runs this in fail mode and uploads the
-report as an artifact.
+(``scripts/analysis_gate.py``) runs this in fail mode, diffs the
+certificate against the committed ``analysis/exactness_bounds.toml``,
+and uploads the JSON (and ``--sarif``) artifacts.
 """
 
 from __future__ import annotations
@@ -18,22 +26,28 @@ from typing import Callable, List, Optional, Tuple
 
 from tuplewise_tpu.analysis import compile_ladder
 from tuplewise_tpu.analysis import config_drift
+from tuplewise_tpu.analysis import exactness
 from tuplewise_tpu.analysis import lock_order
 from tuplewise_tpu.analysis import modgraph
+from tuplewise_tpu.analysis import races
 from tuplewise_tpu.analysis import telemetry_xref
 from tuplewise_tpu.analysis import traced_purity
+from tuplewise_tpu.analysis.cache import ParseCache
 from tuplewise_tpu.analysis.core import Finding, ModuleSet
 from tuplewise_tpu.analysis.waivers import (
     WaiverError, apply_waivers, load_waivers,
 )
 
-#: (name, pass callable) — the five invariant passes + import cycles
+#: (name, pass callable) — five syntactic passes [ISSUE 12], the two
+#: dataflow-tier passes [ISSUE 13], and the module-graph report
 PASSES: Tuple[Tuple[str, Callable[[ModuleSet], List[Finding]]], ...] = (
     ("lock-order", lock_order.run),
     ("traced-purity", traced_purity.run),
     ("telemetry-xref", telemetry_xref.run),
     ("compile-ladder", compile_ladder.run),
     ("config-drift", config_drift.run),
+    ("races", races.run),
+    ("exactness", exactness.run),
     ("module-graph", modgraph.run),
 )
 
@@ -48,12 +62,15 @@ def repo_root() -> str:
 def run_checks(root: Optional[str] = None,
                waivers_path: Optional[str] = None,
                strict: bool = False,
-               ms: Optional[ModuleSet] = None) -> dict:
+               ms: Optional[ModuleSet] = None,
+               use_cache: bool = True) -> dict:
     """The whole check as one JSON-able report dict; ``ms`` overrides
     the repo walk (fixture tests)."""
     root = root or repo_root()
+    cache = None
     if ms is None:
-        ms = ModuleSet.from_repo(root)
+        cache = ParseCache(root) if use_cache else None
+        ms = ModuleSet.from_repo(root, cache=cache)
 
     findings: List[Finding] = []
     per_pass = {}
@@ -78,6 +95,11 @@ def run_checks(root: Optional[str] = None,
 
     unwaived, waived, unused = apply_waivers(findings, waivers)
 
+    # overflow certificate [ISSUE 13]: the per-accumulator bound table
+    # at the declared compile-ladder maxima; ok=False bounds already
+    # surfaced as overflow-int32 findings through the exactness pass
+    cert = exactness.certificates(ms)
+
     ok = not unwaived and waiver_error is None \
         and not ms.parse_errors and not (strict and unused)
     report = {
@@ -90,7 +112,11 @@ def run_checks(root: Optional[str] = None,
             "waived": len(waived),
             "waivers_unused": len(unused),
             "per_pass": per_pass,
+            "cache": (cache.stats() if cache is not None
+                      else {"enabled": False, "hits": 0,
+                            "misses": 0}),
         },
+        "overflow_certificate": cert,
         "findings": [f.to_dict() for f in unwaived],
         "waived": [dict(f.to_dict(), reason=w.reason,
                         waiver_line=w.line) for f, w in waived],
@@ -111,7 +137,9 @@ def run_checks(root: Optional[str] = None,
 def main(args) -> int:
     """CLI entry (argparse namespace from harness/cli.py)."""
     report = run_checks(root=args.root, waivers_path=args.waivers,
-                        strict=args.strict)
+                        strict=args.strict,
+                        use_cache=not getattr(args, "no_cache",
+                                              False))
     if args.out:
         d = os.path.dirname(args.out)
         if d:
@@ -122,9 +150,13 @@ def main(args) -> int:
         print(json.dumps(report, indent=2))
     else:
         s = report["summary"]
+        c = s["cache"]
+        cache_note = (f", cache {c['hits']} hit/{c['misses']} miss"
+                      if c["enabled"] else ", cache off")
         print(f"tuplewise check: {s['files_analyzed']} files, "
               f"{s['findings_total']} findings "
-              f"({s['waived']} waived, {s['unwaived']} unwaived)")
+              f"({s['waived']} waived, {s['unwaived']} unwaived)"
+              f"{cache_note}")
         for f in report["findings"]:
             print(f"  {f['rule']}: {f['file']}:{f['line']} "
                   f"[{f['symbol']}]\n    {f['message']}")

@@ -40,7 +40,7 @@ import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from tuplewise_tpu.analysis.core import (
-    Finding, ModuleSet, call_name, glob_match, literal_str,
+    Finding, ModuleSet, call_name, dotted, glob_match, literal_str,
     name_or_glob,
 )
 
@@ -97,13 +97,26 @@ def collect_producers(ms: ModuleSet
                     if s is not None:
                         row_keys.add(s)
             # out["kernel_calls_per_batch"] = ... — subscript writes
-            # produce row fields just like dict literals do
+            # produce row fields just like dict literals do; augmented
+            # writes (out["n"] += 1) and .setdefault("k", ...) too
+            # [ISSUE 13 satellite: PR 12 triage precision fix]
             elif isinstance(node, ast.Assign):
                 for t in node.targets:
                     if isinstance(t, ast.Subscript):
                         s = literal_str(t.slice)
                         if s is not None:
                             row_keys.add(s)
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Subscript):
+                s = literal_str(node.target.slice)
+                if s is not None:
+                    row_keys.add(s)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "setdefault" and node.args:
+                s = literal_str(node.args[0])
+                if s is not None:
+                    row_keys.add(s)
             if not isinstance(node, ast.Call):
                 continue
             cn = call_name(node)
@@ -316,7 +329,7 @@ def run(ms: ModuleSet, consumer_paths=_DEFAULT_CONSUMERS
                 "passes vacuously"))
 
     known = set(flights) | row_keys | _config_fields(ms) \
-        | _param_names(ms)
+        | _param_names(ms) | _attr_names(ms)
     for path, base in doc_tokens(ms):
         if not _produced(base, metrics) and base not in known:
             findings.append(Finding(
@@ -398,6 +411,27 @@ def _param_names(ms: ModuleSet) -> Set[str]:
                       + ([args.kwarg] if args.kwarg else [])):
                 out.add(a.arg)
             out.add(getattr(node, "name", ""))
+    return out
+
+
+def _attr_names(ms: ModuleSet) -> Set[str]:
+    """Instance-attribute names assigned anywhere (``self.x = ...``):
+    docs legitimately backtick object state (``n_evicted``,
+    ``retry_backoff_s``) that is neither a metric nor a config field
+    [ISSUE 13 satellite: PR 12 triage precision fix]."""
+    out: Set[str] = set()
+    for path, mi in ms.modules.items():
+        for node in ast.walk(mi.tree):
+            targets = ()
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = (node.target,)
+            for t in targets:
+                d = dotted(t)
+                if d and d.startswith("self.") \
+                        and "." not in d[len("self."):]:
+                    out.add(d[len("self."):])
     return out
 
 

@@ -616,12 +616,16 @@ def main(argv=None) -> int:
 
     p = sub.add_parser(
         "check",
-        help="static invariant checks [ISSUE 12]: lock-order/thread "
-             "discipline, traced-code purity, telemetry cross-"
+        help="static invariant checks [ISSUE 12/13]: lock-order/"
+             "thread discipline, traced-code purity, telemetry cross-"
              "reference, compile-ladder discipline, config/CLI/doc "
-             "drift, import cycles — findings suppressible only via "
-             "the committed analysis/waivers.toml (DESIGN §17); exit "
-             "0 = clean modulo waivers, 1 = unwaived findings",
+             "drift, import cycles, PLUS the flow-sensitive dataflow "
+             "tier — guard-inference race detection across thread "
+             "roles and integer-exactness/int32-overflow "
+             "certification of the count paths — findings "
+             "suppressible only via the committed "
+             "analysis/waivers.toml (DESIGN §17); exit 0 = clean "
+             "modulo waivers, 1 = unwaived findings",
     )
     p.add_argument("--root", type=str, default=None,
                    help="repo root to analyze (default: the checkout "
@@ -639,6 +643,10 @@ def main(argv=None) -> int:
     p.add_argument("--strict", action="store_true",
                    help="stale waivers (matching nothing) fail the "
                         "run instead of warning")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the content-sha parse cache "
+                        "(.tuplewise_check_cache/) and reparse "
+                        "every module [ISSUE 13]")
 
     p = sub.add_parser(
         "replay",

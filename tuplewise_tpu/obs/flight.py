@@ -115,12 +115,18 @@ class FlightRecorder:
     def dump(self) -> dict:
         """The full dump as one JSON-able dict."""
         evs = self.events()
+        with self._lock:
+            # ``dropped`` is written under the lock in record(); the
+            # guard-inference pass [ISSUE 13] flagged this read as the
+            # one access outside it — a torn read here would ship a
+            # wrong drop count into the forensics header
+            dropped = self.dropped
         return {
             "format": "tuplewise-flight-v1",
             "dumped_at_wall": time.time(),
             "dumped_at_mono": time.perf_counter(),
             "n_events": len(evs),
-            "dropped": self.dropped,
+            "dropped": dropped,
             "events": evs,
         }
 
