@@ -931,6 +931,11 @@ def _streaming_main(args):
         obs["profile_dir"] = args.profile_dir
     if args.slo_spec:
         obs["slo_spec"] = args.slo_spec
+    if args.prof or args.prof_out:
+        # host-tax sampling profiler [ISSUE 14]: brackets only the
+        # main timed run (replay starts/stops it around the window)
+        obs["prof"] = True
+        obs["prof_out"] = args.prof_out
     rec, base, sync = _streaming_events_per_sec(
         n_events=args.n_events, budget=args.budget,
         max_batch=args.max_batch, window=args.window,
@@ -960,6 +965,11 @@ def _streaming_main(args):
         # actually goes (queue wait vs index vs wal vs snapshot)
         "insert_stage_p99_ms": rec.get("insert_stage_p99_ms"),
         "stage_attribution": rec.get("stage_attribution"),
+        # host-tax ledger [ISSUE 14]: the wall-clock split (host
+        # Python vs device vs compile vs GC) the one-dispatch serving
+        # core will be measured against; also stamped as its own
+        # serving.jsonl stage row for the perf gate
+        "host_tax": rec.get("host_tax"),
         "trace_out": rec.get("trace_out"),
         "metrics_out": rec.get("metrics_out"),
         "bg_compact": not args.sync_compact,
@@ -1031,9 +1041,20 @@ def _streaming_main(args):
         # shedding vs the uncontrolled hard-reject flood
         out["controller_defense"] = _controller_cell(
             n_events=args.controller_bench_n)
+    if rec.get("prof_out"):
+        out["prof_out"] = rec["prof_out"]
+        out["prof_samples"] = rec.get("prof_samples")
+        out["prof_overhead_fraction"] = rec.get("prof_overhead_fraction")
     print(json.dumps(out))
     if args.out:
         rows = [dict(out, stage="bench_streaming")]
+        if out.get("host_tax"):
+            # the stamped host-tax row [ISSUE 14]: host_fraction /
+            # device_fraction / compile_events / gc_pause_p99 join the
+            # perf-gate trajectory under their own stage
+            rows.append(dict(out["host_tax"], stage="host_tax",
+                             run_id=run_id,
+                             config_digest=out.get("config_digest")))
         if out.get("delta_compaction"):
             rows.append(dict(out["delta_compaction"],
                              stage="delta_compaction", run_id=run_id))
@@ -1148,6 +1169,15 @@ def main():
     ap.add_argument("--profile-dir", type=str, default=None,
                     help="with --streaming: bracket the main run in a "
                          "jax.profiler trace written here")
+    ap.add_argument("--prof", action="store_true",
+                    help="with --streaming: run the host-tax sampling "
+                         "profiler over the main timed run (<= 5%% "
+                         "guarded overhead) [ISSUE 14]")
+    ap.add_argument("--prof-out", type=str, default=None,
+                    help="with --streaming: write the profile here "
+                         "(*.collapsed/*.txt = folded stacks, else "
+                         "speedscope JSON; implies --prof); digest "
+                         "with scripts/trace_summary.py")
     args = ap.parse_args()
     if args.streaming:
         _streaming_main(args)

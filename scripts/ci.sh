@@ -110,13 +110,18 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
-# Observability smoke [ISSUE 6]: a traced chaos replay must produce a
-# schema-valid Chrome/perfetto trace whose per-stage spans sum to the
-# measured insert latency (>= 95% per trace), a metrics.jsonl with >= 2
-# periodic registry snapshots, and a flight-recorder dump in which
-# every injected fault / compaction / heal appears exactly once with a
-# correlating trace id; the trace/metrics/flight files land under
-# results/ for the CI artifact.
+# Observability smoke [ISSUE 6; profiler leg ISSUE 14]: a traced
+# chaos replay must produce a schema-valid Chrome/perfetto trace whose
+# per-stage spans sum to the measured insert latency (>= 95% per
+# trace), a metrics.jsonl with >= 2 periodic registry snapshots, a
+# flight-recorder dump in which every injected fault / compaction /
+# heal appears exactly once with a correlating trace id, PLUS the
+# host-tax leg: the wave ledger's bucket sums tile the measured
+# insert latency EXACTLY (coverage == 1.0), >= 1 tail exemplar lands
+# under the injected 60ms batcher delay, and the sampling profiler's
+# speedscope + collapsed exports are schema-valid and digestible into
+# the host-tax table; all files land under results/ for the CI
+# artifact.
 timeout -k 10 240 env JAX_PLATFORMS=cpu \
     python scripts/obs_smoke.py
 rc=$?

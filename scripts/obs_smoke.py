@@ -10,10 +10,16 @@ A traced replay of a chaos schedule must produce, in one run:
     digest;
 (c) a flight-recorder dump in which every injected fault and every
     compaction / major-merge / heal event appears exactly once, with a
-    correlating (non-null) trace id on each injected fault —
+    correlating (non-null) trace id on each injected fault;
+(d) [ISSUE 14] a host-tax ledger whose bucket sums tile the measured
+    insert latency EXACTLY (coverage == 1.0), >= 1 tail exemplar
+    captured under the injected latency chaos (a scheduled batcher
+    ``delay`` stalls queued requests past ``tail_exemplar_ms``), and a
+    schema-valid speedscope + collapsed-stack profiler export —
 
 while the span-JSONL export stays digestible by
-``scripts/trace_summary.py``. Any breach exits nonzero; the summary
+``scripts/trace_summary.py`` (which must also digest the collapsed
+stacks into the host-tax table). Any breach exits nonzero; the summary
 row (stage "obs_smoke") lands in a JSONL the workflow uploads.
 
 Usage: python scripts/obs_smoke.py [--n-events 4000]
@@ -30,8 +36,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# the batcher 'delay' is the injected latency chaos [ISSUE 14]: a 60ms
+# stall between batches ages every queued request past the 25ms
+# exemplar threshold, so >= 1 tail_exemplar MUST land in the flight
+# ring (the doctor resolves a delay fault as latency_absorbed)
+TAIL_EXEMPLAR_MS = 25.0
 CHAOS = {"faults": [
     {"point": "compactor_build", "on_call": 1, "action": "error"},
+    {"point": "batcher", "on_call": 5, "action": "delay",
+     "seconds": 0.06},
     {"point": "batcher", "on_call": 15, "action": "error"},
     {"point": "poison", "at_events": [150, 900], "value": "nan"},
 ]}
@@ -171,6 +184,77 @@ def _check_slo(rec: dict, metrics_path: str) -> int:
     return 0
 
 
+def _check_host_tax(rec: dict, flight_path: str) -> int:
+    """[ISSUE 14] Ledger tiling (coverage == 1.0 up to float
+    rounding), sane fraction split, and >= 1 tail exemplar (with its
+    full bucket ledger) captured under the injected latency chaos."""
+    ht = rec.get("host_tax")
+    if not ht:
+        return _fail("record has no host_tax block")
+    cov = ht.get("coverage")
+    if cov is None or abs(cov - 1.0) > 1e-6:
+        return _fail(f"ledger coverage {cov!r} != 1.0 — an interval "
+                     "escaped the bucket tiling")
+    fracs = (ht.get("host_fraction"), ht.get("device_fraction"))
+    if any(f is None or not 0.0 <= f <= 1.0 for f in fracs):
+        return _fail(f"host/device fractions out of range: {fracs}")
+    if not ht.get("waves"):
+        return _fail("ledger recorded no waves")
+    from tuplewise_tpu.obs.flight import FlightRecorder
+
+    exemplars = [e for e in FlightRecorder.load_dump(
+        flight_path)["events"] if e["kind"] == "tail_exemplar"]
+    if not exemplars:
+        return _fail("no tail_exemplar under the injected 60ms delay "
+                     f"(threshold {TAIL_EXEMPLAR_MS}ms)")
+    for e in exemplars:
+        if e.get("lat_ms", 0) < TAIL_EXEMPLAR_MS:
+            return _fail(f"exemplar below threshold: {e}")
+        b = e.get("buckets")
+        if not b or "queue_wait" not in b or "host_python" not in b:
+            return _fail(f"exemplar missing its bucket ledger: {e}")
+    print(f"  host tax OK: coverage={cov:.9f} host="
+          f"{fracs[0]:.3f} device={fracs[1]:.3f} "
+          f"exemplars={len(exemplars)}", file=sys.stderr)
+    return 0
+
+
+def _check_speedscope(path: str) -> int:
+    """[ISSUE 14] The profiler's speedscope export must be schema-
+    valid: shared frame table, one sampled profile, index-consistent
+    samples, weights aligned 1:1."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if "speedscope" not in doc.get("$schema", ""):
+        return _fail(f"speedscope $schema missing: {doc.get('$schema')}")
+    frames = doc.get("shared", {}).get("frames")
+    if not isinstance(frames, list) or not frames \
+            or not all(isinstance(fr, dict) and "name" in fr
+                       for fr in frames):
+        return _fail("speedscope shared.frames malformed")
+    profs = doc.get("profiles")
+    if not isinstance(profs, list) or not profs:
+        return _fail("speedscope has no profiles")
+    p = profs[0]
+    if p.get("type") != "sampled" or p.get("unit") != "seconds":
+        return _fail(f"speedscope profile wrong type/unit: {p.get('type')}"
+                     f"/{p.get('unit')}")
+    samples, weights = p.get("samples"), p.get("weights")
+    if not isinstance(samples, list) or not samples \
+            or len(samples) != len(weights):
+        return _fail("speedscope samples/weights misaligned")
+    nf = len(frames)
+    for s in samples:
+        if not s or any(not isinstance(i, int) or not 0 <= i < nf
+                        for i in s):
+            return _fail(f"speedscope sample indexes out of range: {s}")
+    if abs(sum(weights) - p.get("endValue", -1)) > 1e-6:
+        return _fail("speedscope endValue != sum(weights)")
+    print(f"  speedscope OK: {len(samples)} samples over {nf} frames",
+          file=sys.stderr)
+    return 0
+
+
 def _check_flight(path: str, rec: dict) -> int:
     from tuplewise_tpu.obs.flight import FlightRecorder
 
@@ -225,10 +309,16 @@ def main(argv=None) -> int:
     spans_jsonl = os.path.join(args.results_dir, "obs_spans.jsonl")
     metrics_out = os.path.join(args.results_dir, "metrics.jsonl")
     flight_out = os.path.join(args.results_dir, "obs_flight.jsonl")
-    for p in (trace_json, spans_jsonl, metrics_out, flight_out):
+    prof_speedscope = os.path.join(args.results_dir,
+                                   "obs_prof.speedscope.json")
+    prof_collapsed = os.path.join(args.results_dir,
+                                  "obs_prof.collapsed")
+    for p in (trace_json, spans_jsonl, metrics_out, flight_out,
+              prof_speedscope, prof_collapsed):
         if os.path.exists(p):
             os.unlink(p)
 
+    from tuplewise_tpu.obs.prof import SamplingProfiler
     from tuplewise_tpu.obs.tracing import Tracer
     from tuplewise_tpu.serving import ServingConfig
     from tuplewise_tpu.serving.replay import make_stream, replay
@@ -236,12 +326,16 @@ def main(argv=None) -> int:
     scores, labels = make_stream(args.n_events, pos_frac=0.5,
                                  separation=1.0, seed=0)
     cfg = ServingConfig(policy="block", flush_timeout_s=0.002,
-                        compact_every=256, bg_compact=True)
+                        compact_every=256, bg_compact=True,
+                        tail_exemplar_ms=TAIL_EXEMPLAR_MS)
     tracer = Tracer(capacity=1 << 17)
+    profiler = SamplingProfiler()   # [ISSUE 14]: the profiler leg
     rec = replay(scores, labels, config=cfg, max_inflight=256,
                  chaos=CHAOS, tracer=tracer, trace_out=trace_json,
                  metrics_out=metrics_out, metrics_every_s=0.2,
-                 flight_out=flight_out, slo_spec=SLO)
+                 flight_out=flight_out, slo_spec=SLO,
+                 prof=profiler, prof_out=prof_speedscope)
+    profiler.export_collapsed(prof_collapsed)
     tracer.export_jsonl(spans_jsonl)
     if tracer.dropped:
         return _fail(f"tracer ring dropped {tracer.dropped} spans — "
@@ -251,17 +345,22 @@ def main(argv=None) -> int:
           or _check_stage_sums(spans_jsonl)
           or _check_metrics(metrics_out)
           or _check_flight(flight_out, rec)
-          or _check_slo(rec, metrics_out))
+          or _check_slo(rec, metrics_out)
+          or _check_host_tax(rec, flight_out)
+          or _check_speedscope(prof_speedscope))
     if rc:
         return rc
 
-    # the summarizer must digest both exports (the CI artifact a
-    # reviewer actually reads)
-    from scripts.trace_summary import summarize_spans
+    # the summarizer must digest every export (the CI artifacts a
+    # reviewer actually reads): spans, Chrome trace, and the profiler
+    # leg's host-tax table [ISSUE 14]
+    from scripts.trace_summary import summarize_collapsed, summarize_spans
 
     summary = summarize_spans(spans_jsonl, 10)
     summarize_spans(trace_json, 5)
+    host_tax_table = summarize_collapsed(prof_collapsed, 8)
     print(summary, file=sys.stderr)
+    print(host_tax_table, file=sys.stderr)
 
     row = {
         "stage": "obs_smoke",
@@ -274,12 +373,21 @@ def main(argv=None) -> int:
         "auc_abs_err": rec.get("auc_abs_err"),
         "slo_healthy": rec["slo"]["healthy"],
         "slo_evaluations": rec["slo"]["evaluations"],
+        # host-tax leg [ISSUE 14]
+        "host_tax_coverage": rec["host_tax"]["coverage"],
+        "host_fraction": rec["host_tax"]["host_fraction"],
+        "device_fraction": rec["host_tax"]["device_fraction"],
+        "compile_events": rec["host_tax"]["compile_events"],
+        "tail_exemplars": rec["host_tax"]["tail_exemplars"],
+        "prof_samples": rec.get("prof_samples"),
+        "prof_overhead_fraction": rec.get("prof_overhead_fraction"),
     }
     with open(args.out, "w", encoding="utf-8") as f:
         f.write(json.dumps(row) + "\n")
     print(f"obs smoke OK: {rec['trace_spans']} spans, coverage="
-          f"{row['stage_coverage']:.6f}, flight={rec['flight_events']}"
-          f" -> {args.out}", file=sys.stderr)
+          f"{row['stage_coverage']:.6f}, ledger="
+          f"{row['host_tax_coverage']:.6f}, flight="
+          f"{rec['flight_events']} -> {args.out}", file=sys.stderr)
     return 0
 
 

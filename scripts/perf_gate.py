@@ -96,9 +96,20 @@ _STAGE_METRICS = {
         ("insert_latency_p99_ms", "min", "insert_latency_p99_ms"),
         ("kernel_calls_per_batch", "min", "kernel_calls_per_batch"),
     ),
+    # host-tax budget [ISSUE 14]: the ledger row bench.py --streaming
+    # stamps per run. Host fraction creeping UP, steady-state compile
+    # events per 1k batches UP, or the GC pause tail UP are quiet
+    # request-path regressions the throughput band can miss entirely
+    # (a 5% host-fraction climb hides inside the 25% events/s band).
+    "host_tax": (
+        ("host_fraction", "min", "host_fraction"),
+        ("compile_events_per_1k", "min",
+         "compile_events_per_1k_batches"),
+        ("gc_pause_p99_ms", "min", "gc_pause_p99_ms"),
+    ),
 }
 _DEFAULT_STAGES = ("bench_streaming,multi_tenant,fleet_incremental,"
-                   "serving_kernel")
+                   "serving_kernel,host_tax")
 
 # the config fields that make two bench_streaming rows comparable when
 # no config_digest is stamped (pre-ISSUE-7 history)

@@ -1,6 +1,6 @@
 """Summarize a trace into a small text table.
 
-Three input shapes, auto-detected:
+Four input shapes, auto-detected:
 
 * a **directory** — a ``jax.profiler`` trace
   (``<dir>/plugins/profile/<run>/*.trace.json.gz``): device-side
@@ -14,9 +14,16 @@ Three input shapes, auto-detected:
   the span nests), plus a per-stage insert-latency p99 table;
 * a ``*.json`` **file** — the Chrome trace-event export
   (``--trace-out x.json``): same summary, read from the ``X`` events'
-  embedded span/parent ids.
+  embedded span/parent ids;
+* a ``*.collapsed`` / ``*.txt`` / ``*.speedscope.json`` **file** —
+  the sampling profiler's export (``obs.prof.SamplingProfiler``,
+  ``--prof-out``) [ISSUE 14]: samples classified into a **host-tax
+  table** (which layer of the stack the request-thread wall-clock
+  burns in — serving Python, pack/mesh glue, jax dispatch, numpy,
+  WAL/snapshot IO, waiting) plus the top leaf frames.
 
-Usage: python scripts/trace_summary.py <dir | spans.jsonl | trace.json> [top_n]
+Usage: python scripts/trace_summary.py
+           <dir | spans.jsonl | trace.json | prof.collapsed> [top_n]
 """
 
 from __future__ import annotations
@@ -183,10 +190,111 @@ def summarize_spans(path: str, top_n: int = 15) -> str:
     return "\n".join(lines)
 
 
+# --------------------------------------------------------------------- #
+# host-tax digest of sampling-profiler exports [ISSUE 14]                 #
+# --------------------------------------------------------------------- #
+
+# (category, substring-match over the frame's trimmed path) — first
+# match wins, checked leaf-to-root so the innermost classifiable frame
+# decides; order encodes specificity
+_HOST_TAX_CATEGORIES = (
+    ("wait_idle", ("threading.py:wait", "threading.py:_wait",
+                   "queue.py:get", "queue.py:put", "selectors.py:",
+                   "socket.py:", "ssl.py:")),
+    ("gc_or_prof", ("obs/prof.py:", "obs/ledger.py:")),
+    ("wal_snapshot_io", ("serving/recovery.py:",)),
+    ("jax_dispatch", ("jax/", "jaxlib/", "jax\\", "/pjit.py:",
+                      "pallas/")),
+    ("mesh_glue", ("parallel/sharded_counts.py:", "parallel/mesh.py:",
+                   "parallel/self_heal.py:")),
+    ("serving_python", ("serving/", "estimators/")),
+    ("observability", ("obs/", "utils/profiling.py:")),
+    ("numpy_host", ("numpy/", "numpy\\")),
+)
+
+
+def classify_frame(frame: str):
+    for cat, pats in _HOST_TAX_CATEGORIES:
+        for p in pats:
+            if p in frame:
+                return cat
+    return None  # unclassified — caller falls back toward the root
+
+
+def classify_stack(stack) -> str:
+    """Walk leaf→root; the innermost frame with a known category
+    names the sample (a numpy sort called from serving code is
+    numpy_host — the time is IN numpy, which is the honest leaf-time
+    attribution collapsed stacks give)."""
+    for frame in reversed(stack):
+        cat = classify_frame(frame)
+        if cat is not None:
+            return cat
+    return "other_host"
+
+
+def load_collapsed(path: str):
+    """[(stack tuple root→leaf, count)] from a collapsed-stack file or
+    a speedscope "sampled" export."""
+    if path.endswith(".speedscope.json") or path.endswith(".json"):
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        frames = [fr["name"] for fr in doc["shared"]["frames"]]
+        out = []
+        for prof in doc.get("profiles", []):
+            if prof.get("type") != "sampled":
+                continue
+            for sample in prof.get("samples", []):
+                out.append((tuple(frames[i] for i in sample), 1))
+        return out
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            stack, _, n = line.rpartition(" ")
+            out.append((tuple(stack.split(";")), int(n)))
+    return out
+
+
+def summarize_collapsed(path: str, top_n: int = 15) -> str:
+    """The host-tax table: sample share per stack layer, plus the top
+    leaf frames — the committed-text digest of where the host Python
+    time actually burns."""
+    stacks = load_collapsed(path)
+    if not stacks:
+        raise ValueError(f"{path!r} contains no stack samples")
+    by_cat = defaultdict(int)
+    by_leaf = defaultdict(int)
+    total = 0
+    for stack, n in stacks:
+        total += n
+        by_cat[classify_stack(stack)] += n
+        by_leaf[stack[-1]] += n
+    lines = [
+        f"profile: {path}",
+        f"samples: {total}  distinct stacks: {len(stacks)}",
+        "",
+        f"{'host-tax category':<24} {'samples':>8} {'share':>7}",
+    ]
+    for cat, n in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        lines.append(f"{cat:<24} {n:>8} {n / total:>6.1%}")
+    lines += ["", f"{'top leaf frame':<52} {'samples':>8} {'share':>7}"]
+    for leaf, n in sorted(by_leaf.items(),
+                          key=lambda kv: (-kv[1], kv[0]))[:top_n]:
+        nm = leaf if len(leaf) <= 51 else leaf[:48] + "..."
+        lines.append(f"{nm:<52} {n:>8} {n / total:>6.1%}")
+    return "\n".join(lines)
+
+
 if __name__ == "__main__":
     d = sys.argv[1]
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 15
     if os.path.isdir(d):
         print(summarize(d, n))
+    elif d.endswith((".collapsed", ".txt")) \
+            or d.endswith(".speedscope.json"):
+        print(summarize_collapsed(d, n))
     else:
         print(summarize_spans(d, n))

@@ -600,8 +600,15 @@ class TestScenarios:
         never saturates and nobody gets a hard reject."""
         for controlled in (True, False):
             rng = np.random.default_rng(23)
+            # max_batch=8 keeps the breach deterministic [ISSUE 14]:
+            # at 32, the batcher's first pickup could absorb most of
+            # an arrival's 60-request burst alongside the wedge, and
+            # with warm jit caches the observed depth never crossed
+            # the 0.8 saturation line — the uncontrolled twin then
+            # read healthy by luck of DRR timing (seed-reproducible
+            # flake). Small drains can't hide a 60-deep burst.
             cfg = ServingConfig(queue_size=64, policy="reject",
-                                flush_timeout_s=0.001, max_batch=32)
+                                flush_timeout_s=0.001, max_batch=8)
             admitted = {}
             with MultiTenantEngine(cfg, TenancyConfig(
                     max_tenants=128, tenant_quota=4096)) as eng:
@@ -617,6 +624,10 @@ class TestScenarios:
                     wl = rng.random(30_000) < 0.5
                     wedge = eng.insert("base", ws, wl)
                     admitted.setdefault("base", []).append((ws, wl))
+                    # let the batcher claim the wedge ALONE before the
+                    # burst: its long apply wave is what the arrival
+                    # spike piles up behind [ISSUE 14 determinism]
+                    time.sleep(0.005)
                     tid = f"new{arrival}"
                     for i in range(60):
                         s = rng.standard_normal(1)

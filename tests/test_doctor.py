@@ -186,6 +186,125 @@ class TestDegradedPaths:
             diagnose(run_dir=str(tmp_path))
 
 
+def _ht_metrics(compile_events=0, batches=100, gc_p99_s=0.0,
+                gc_pauses=0, insert_p99_s=0.010, fallbacks=0,
+                full_replaces=0):
+    """A final-snapshot metrics dict with a self-consistent host-tax
+    ledger (bucket sums tile insert_latency_s.sum exactly)."""
+    measured = 2.0
+    buckets = {"queue_wait": 0.5, "lock_wait": 0.1,
+               "host_python": 1.0, "dispatch": 0.2,
+               "device_compute": 0.1, "xla_compile": 0.05,
+               "gc_pause": 0.05}
+    m = {
+        "host_tax_waves_total": {"type": "counter", "value": 50},
+        "batches_total": {"type": "counter", "value": batches},
+        "xla_compile_events_total": {"type": "counter",
+                                     "value": compile_events},
+        "gc_pauses_total": {"type": "counter", "value": gc_pauses},
+        "gc_pause_s": {"type": "histogram", "count": gc_pauses,
+                       "sum": gc_p99_s * gc_pauses, "p99": gc_p99_s},
+        "tail_exemplars_total": {"type": "counter", "value": 0},
+        "insert_latency_s": {"type": "histogram", "count": 100,
+                             "sum": measured, "p99": insert_p99_s},
+        "host_tax_host_fraction": {"type": "gauge", "value": 0.85},
+        "host_tax_device_fraction": {"type": "gauge", "value": 0.10},
+        "count_kernel_calls_total": {"type": "counter", "value": 10},
+        "count_kernel_fallbacks_total": {"type": "counter",
+                                         "value": fallbacks},
+        "pack_replaces_total": {"type": "counter", "value": 0},
+        "pack_full_replaces_total": {"type": "counter",
+                                     "value": full_replaces},
+    }
+    for b, s in buckets.items():
+        m[f"host_tax_{b}_s"] = {"type": "histogram", "count": 100,
+                                "sum": s, "p99": s / 100}
+    return m
+
+
+def _rows(metrics, n=3):
+    return [{"seq": i + 1, "ts_wall": float(i), "ts_mono": float(i),
+             "platform": "cpu", "config_digest": "d",
+             "metrics": metrics} for i in range(n)]
+
+
+class TestHostTaxVerdicts:
+    """[ISSUE 14] compile-churn / GC-in-p99 / kernel-fallback
+    degraded-reasons and the host_tax report block."""
+
+    def _diagnose(self, tmp_path, metrics):
+        mpath = tmp_path / "metrics.jsonl"
+        with open(mpath, "w") as f:
+            for r in _rows(metrics):
+                f.write(json.dumps(r) + "\n")
+        return diagnose(metrics_path=str(mpath))
+
+    def test_healthy_run_carries_host_tax_block(self, tmp_path):
+        rep = self._diagnose(tmp_path, _ht_metrics())
+        assert rep["verdict"] == "healthy"
+        ht = rep["host_tax"]
+        assert ht["coverage"] == pytest.approx(1.0)
+        assert ht["host_fraction"] == 0.85
+        assert ht["compile_churn"] is False
+        assert ht["gc_in_p99"] is False
+
+    def test_compile_churn_degrades(self, tmp_path):
+        # > 1 compile per batch in steady state: 200 events / 100
+        # batches = 2000 per 1k
+        rep = self._diagnose(tmp_path,
+                             _ht_metrics(compile_events=200))
+        assert "compile_on_request_thread" in rep["verdict"]
+        assert rep["host_tax"]["compile_churn"] is True
+        assert rep["verdict_line"]["healthy"] is False
+
+    def test_gc_in_p99_degrades(self, tmp_path):
+        # 8ms GC p99 against a 10ms insert p99, 40 pauses
+        rep = self._diagnose(tmp_path, _ht_metrics(
+            gc_p99_s=0.008, gc_pauses=40, insert_p99_s=0.010))
+        assert "gc_in_p99" in rep["verdict"]
+        assert rep["host_tax"]["gc_in_p99"] is True
+
+    def test_rare_gc_does_not_degrade(self, tmp_path):
+        # a big pause but below GC_MIN_PAUSES occurrences: noise
+        rep = self._diagnose(tmp_path, _ht_metrics(
+            gc_p99_s=0.008, gc_pauses=3, insert_p99_s=0.010))
+        assert rep["verdict"] == "healthy"
+
+    def test_kernel_fallback_degrades(self, tmp_path):
+        rep = self._diagnose(tmp_path, _ht_metrics(fallbacks=2,
+                                                   full_replaces=5))
+        assert "count_kernel_fallback" in rep["verdict"]
+        assert rep["kernel"]["count_kernel_fallbacks"] == 2
+        assert rep["kernel"]["pack_full_replaces"] == 5
+
+    def test_pre_ledger_artifacts_omit_block(self, tmp_path):
+        m = {"insert_latency_s": {"type": "histogram", "count": 10,
+                                  "sum": 1.0, "p99": 0.01}}
+        rep = self._diagnose(tmp_path, m)
+        assert "host_tax" not in rep
+        assert rep["verdict"] == "healthy"
+
+    def test_context_overrides_thresholds(self, tmp_path):
+        mpath = tmp_path / "metrics.jsonl"
+        with open(mpath, "w") as f:
+            for r in _rows(_ht_metrics(compile_events=50)):
+                f.write(json.dumps(r) + "\n")
+        rep = diagnose(metrics_path=str(mpath),
+                       context={"compile_churn_per_1k_batches": 100.0})
+        assert "compile_on_request_thread" in rep["verdict"]
+
+    def test_delay_fault_resolves_as_latency_absorbed(self, tmp_path):
+        evs = [{"kind": "chaos_inject", "seq": 1, "t_wall": 0.0,
+                "point": "batcher", "action": "delay", "trace_id": 3},
+               {"kind": "tail_exemplar", "seq": 2, "t_wall": 0.1,
+                "trace_id": 4, "lat_ms": 80.0, "buckets": {}}]
+        faults = correlate_faults(evs, [], [])
+        assert len(faults) == 1
+        f = faults[0]
+        assert f["resolved"] and f["resolution"] == "latency_absorbed"
+        assert f["evidence"] == {"tail_exemplars": 1}
+
+
 class TestUnits:
     def test_top_self_spans_subtracts_children(self):
         spans = [

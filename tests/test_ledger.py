@@ -1,0 +1,262 @@
+"""Host-tax wave ledger [ISSUE 14]: bucket tiling invariant, compile
+first-seen classification, GC attribution, device sections, tail
+exemplars, and the engine-integrated coverage == 1.0 contract."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+from tuplewise_tpu.obs import ledger as ledger_mod
+from tuplewise_tpu.obs.ledger import (
+    BUCKETS, WaveLedger, device_section, reset_seen,
+)
+from tuplewise_tpu.obs.report import (
+    HOST_TAX_BUCKETS, host_tax_block, host_tax_metric,
+)
+from tuplewise_tpu.utils.profiling import MetricsRegistry
+
+
+def _bucket_sums(snap):
+    return {b: snap.get(host_tax_metric(b), {}).get("sum", 0.0)
+            for b in BUCKETS}
+
+
+class TestWaveLedger:
+    def test_bucket_taxonomy_matches_report(self):
+        # one taxonomy, two modules — they can never drift
+        assert BUCKETS == HOST_TAX_BUCKETS
+
+    def test_tiling_exact_no_device_work(self):
+        reg = MetricsRegistry()
+        led = WaveLedger(reg)
+        w = led.begin_wave()
+        t0 = time.perf_counter()
+        time.sleep(0.002)
+        t1 = time.perf_counter()
+        buckets = led.finish_wave(w, t_start=t0, t_end=t1,
+                                  queue_waits=[0.001, 0.003])
+        snap = reg.snapshot()
+        sums = _bucket_sums(snap)
+        total = sum(sums.values())
+        # 2 requests bill the wave window each + their queue waits
+        expect = 0.001 + 0.003 + 2 * (t1 - t0)
+        assert total == pytest.approx(expect, rel=1e-9)
+        # everything but queue_wait landed in host_python
+        assert sums["host_python"] == pytest.approx(2 * (t1 - t0),
+                                                    rel=1e-9)
+        assert buckets["dispatch"] == 0.0
+        assert buckets["device_compute"] == 0.0
+
+    def test_lock_wait_split_out(self):
+        reg = MetricsRegistry()
+        led = WaveLedger(reg)
+        w = led.begin_wave()
+        t0 = time.perf_counter()
+        t_req = time.perf_counter()
+        time.sleep(0.002)
+        t_lock = time.perf_counter()
+        time.sleep(0.001)
+        t1 = time.perf_counter()
+        led.finish_wave(w, t_start=t0, t_end=t1, queue_waits=[0.0],
+                        t_lock_req=t_req, t_lock=t_lock)
+        sums = _bucket_sums(reg.snapshot())
+        assert sums["lock_wait"] == pytest.approx(t_lock - t_req,
+                                                  rel=1e-9)
+        assert sum(sums.values()) == pytest.approx(t1 - t0, rel=1e-9)
+
+    def test_device_section_first_seen_is_compile(self):
+        reset_seen()
+        reg = MetricsRegistry()
+        led = WaveLedger(reg)
+        w = led.begin_wave()
+        t0 = time.perf_counter()
+        with device_section(("test_fn", 256, 256)) as ds:
+            time.sleep(0.001)
+            ds.dispatched()
+            time.sleep(0.001)
+        with device_section(("test_fn", 256, 256)) as ds:
+            time.sleep(0.001)
+            ds.dispatched()
+        t1 = time.perf_counter()
+        led.finish_wave(w, t_start=t0, t_end=t1, queue_waits=[0.0])
+        snap = reg.snapshot()
+        # first key occurrence billed compile, second dispatch
+        assert snap["xla_compile_events_total"]["value"] == 1
+        sums = _bucket_sums(snap)
+        assert sums["xla_compile"] > 0
+        assert sums["dispatch"] > 0
+        assert sums["device_compute"] > 0
+        assert sum(sums.values()) == pytest.approx(t1 - t0, rel=1e-9)
+
+    def test_device_section_offwave_is_noop(self):
+        reset_seen()
+        reg = MetricsRegistry()
+        WaveLedger(reg)   # no wave begun on this thread
+        with device_section(("offwave", 1)) as ds:
+            ds.dispatched()
+        snap = reg.snapshot()
+        assert snap["xla_compile_events_total"]["value"] == 0
+        # the key was NOT consumed: a later on-wave dispatch of the
+        # same key still classifies as its first (compiling) call
+        assert ledger_mod._note_key(("offwave", 1)) is True
+
+    def test_gc_pause_attributed_and_tiled(self):
+        reg = MetricsRegistry()
+        led = WaveLedger(reg)
+        w = led.begin_wave()
+        t0 = time.perf_counter()
+        gc.collect()
+        t1 = time.perf_counter()
+        led.finish_wave(w, t_start=t0, t_end=t1, queue_waits=[0.0])
+        snap = reg.snapshot()
+        assert snap["gc_pauses_total"]["value"] >= 1
+        assert snap["gc_pause_s"]["count"] >= 1
+        sums = _bucket_sums(snap)
+        assert sums["gc_pause"] >= 0.0
+        assert sum(sums.values()) == pytest.approx(t1 - t0, rel=1e-9)
+
+    def test_gc_outside_wave_not_recorded(self):
+        reg = MetricsRegistry()
+        WaveLedger(reg)
+        gc.collect()
+        assert reg.snapshot()["gc_pauses_total"]["value"] == 0
+
+    def test_abort_wave_clears_binding(self):
+        reg = MetricsRegistry()
+        led = WaveLedger(reg)
+        w = led.begin_wave()
+        led.abort_wave(w)
+        with device_section(("aborted", 1)) as ds:
+            ds.dispatched()
+        assert reg.snapshot()["host_tax_waves_total"]["value"] == 0
+
+    def test_fraction_gauges_partition(self):
+        reset_seen()
+        reg = MetricsRegistry()
+        led = WaveLedger(reg)
+        w = led.begin_wave()
+        t0 = time.perf_counter()
+        with device_section(("frac", 1)) as ds:
+            ds.dispatched()
+            time.sleep(0.002)
+        t1 = time.perf_counter()
+        led.finish_wave(w, t_start=t0, t_end=t1, queue_waits=[0.0])
+        snap = reg.snapshot()
+        host = snap["host_tax_host_fraction"]["value"]
+        dev = snap["host_tax_device_fraction"]["value"]
+        assert 0.0 <= host <= 1.0 and 0.0 <= dev <= 1.0
+        # host + device + compile fractions tile 1 (compile here is
+        # the first-seen "frac" key's dispatch interval, ~0)
+        assert host + dev <= 1.0 + 1e-9
+        assert dev > 0.0
+
+
+class TestEngineIntegration:
+    def test_coverage_exactly_one_and_exemplars(self):
+        from tuplewise_tpu.serving import (
+            MicroBatchEngine, ServingConfig,
+        )
+
+        reset_seen()
+        rng = np.random.default_rng(0)
+        cfg = ServingConfig(policy="block", compact_every=64,
+                            engine="numpy", tail_exemplar_ms=1e-4)
+        with MicroBatchEngine(cfg) as eng:
+            for i in range(40):
+                eng.insert(rng.standard_normal(8),
+                           rng.random(8) < 0.5)
+            eng.flush()
+            snap = eng.metrics.snapshot()
+            flight = eng.flight
+            ht = host_tax_block(snap)
+            assert ht is not None
+            assert ht["coverage"] == pytest.approx(1.0, abs=1e-6)
+            assert ht["waves"] >= 1
+            # threshold of 0.1us means every insert is an exemplar
+            exemplars = flight.events("tail_exemplar")
+            assert exemplars
+            ev = exemplars[0]
+            assert ev["lat_ms"] >= 1e-4
+            # the exemplar carries the FULL ledger: every bucket,
+            # including its own per-request queue_wait
+            assert set(ev["buckets"]) == set(BUCKETS)
+        assert snap["tail_exemplars_total"]["value"] == len(exemplars)
+
+    def test_no_exemplars_without_threshold(self):
+        from tuplewise_tpu.serving import (
+            MicroBatchEngine, ServingConfig,
+        )
+
+        cfg = ServingConfig(policy="block", engine="numpy")
+        with MicroBatchEngine(cfg) as eng:
+            eng.insert([1.0, -1.0], [True, False]).result(10)
+            eng.flush()
+            assert not eng.flight.events("tail_exemplar")
+            assert eng.metrics.snapshot()[
+                "tail_exemplars_total"]["value"] == 0
+
+    def test_jax_engine_compile_events_and_coverage(self):
+        from tuplewise_tpu.serving import (
+            MicroBatchEngine, ServingConfig,
+        )
+
+        reset_seen()
+        rng = np.random.default_rng(1)
+        cfg = ServingConfig(policy="block", compact_every=128)
+        with MicroBatchEngine(cfg) as eng:
+            for _ in range(10):
+                eng.insert(rng.standard_normal(64),
+                           rng.random(64) < 0.5)
+            eng.flush()
+            snap = eng.metrics.snapshot()
+        ht = host_tax_block(snap)
+        assert ht["coverage"] == pytest.approx(1.0, abs=1e-6)
+        # the bucket ladder compiled at least one count shape inside
+        # the waves — the first-call events the ledger must see
+        assert snap["xla_compile_events_total"]["value"] >= 1
+        sums = _bucket_sums(snap)
+        assert sums["xla_compile"] > 0
+
+    def test_validation_rejects_bad_threshold(self):
+        from tuplewise_tpu.serving import ServingConfig
+
+        with pytest.raises(ValueError):
+            ServingConfig(tail_exemplar_ms=0.0)
+
+    def test_fleet_ledger_coverage(self):
+        from tuplewise_tpu.serving import (
+            MultiTenantEngine, ServingConfig, TenancyConfig,
+        )
+
+        reset_seen()
+        rng = np.random.default_rng(2)
+        cfg = ServingConfig(policy="block", compact_every=128,
+                            tail_exemplar_ms=1e-4)
+        with MultiTenantEngine(cfg, TenancyConfig()) as eng:
+            for i in range(12):
+                eng.insert(f"t{i % 3}", rng.standard_normal(16),
+                           rng.random(16) < 0.5)
+            eng.flush()
+            snap = eng.metrics.snapshot()
+            exemplars = eng.flight.events("tail_exemplar")
+        ht = host_tax_block(snap)
+        assert ht is not None
+        assert ht["coverage"] == pytest.approx(1.0, abs=1e-6)
+        # fleet exemplars carry the owning tenant
+        assert exemplars and all("tenant" in e for e in exemplars)
+
+
+class TestConfigDigestCompat:
+    def test_tail_exemplar_default_keeps_digest(self):
+        # additive-config contract [ISSUE 10 satellite]: the new field
+        # at its default must not orphan committed perf-gate history
+        from tuplewise_tpu.obs.metrics_export import config_digest
+        from tuplewise_tpu.serving import ServingConfig
+
+        base = config_digest(ServingConfig())
+        assert config_digest(
+            ServingConfig(tail_exemplar_ms=None)) == base
+        assert config_digest(
+            ServingConfig(tail_exemplar_ms=5.0)) != base

@@ -362,3 +362,54 @@ class TestFlusherRotationAndObservers:
         fl.stop()
         assert fl.last_flush_error is not None
         assert len([x for x in open(p)]) == 3
+
+    def test_stop_bounded_by_wedged_observer(self, tmp_path):
+        """[ISSUE 14 bugfix] stop() must NOT inherit a wedged
+        observer's hang: observers run under the flush lock, so the
+        old final-flush-then-close path deadlocked shutdown behind
+        whatever the observer was stuck on. Now stop() joins with a
+        timeout, counts flusher_late_flushes_total, and the in-flight
+        flush closes the file when it finally completes."""
+        import threading
+        import time as _time
+
+        reg = MetricsRegistry()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def wedged(row):
+            if row["seq"] >= 2:      # the first flush is start()'s
+                entered.set()
+                release.wait(20.0)   # wedged until the test releases
+
+        p = str(tmp_path / "m.jsonl")
+        fl = MetricsFlusher(reg, p, every_s=0.02,
+                            observers=[wedged])
+        fl.start()
+        assert entered.wait(10.0)
+        t0 = _time.perf_counter()
+        fl.stop(timeout=0.2)         # must return promptly, not hang
+        stop_s = _time.perf_counter() - t0
+        assert stop_s < 5.0
+        snap = reg.snapshot()
+        assert snap["flusher_late_flushes_total"]["value"] == 1
+        assert "wedged" in (fl.last_flush_error or "")
+        # release the observer: the in-flight flush completes, closes
+        # the file, and the thread exits
+        release.set()
+        deadline = _time.perf_counter() + 10.0
+        while fl._f is not None and _time.perf_counter() < deadline:
+            _time.sleep(0.01)
+        assert fl._f is None
+        rows = [json.loads(x) for x in open(p) if x.strip()]
+        assert rows and rows[-1]["seq"] >= 2
+
+    def test_stop_without_wedge_counts_nothing(self, tmp_path):
+        reg = MetricsRegistry()
+        p = str(tmp_path / "m.jsonl")
+        fl = MetricsFlusher(reg, p, every_s=10.0)
+        fl.start()
+        fl.stop()
+        assert reg.snapshot()[
+            "flusher_late_flushes_total"]["value"] == 0
+        assert fl._f is None

@@ -91,6 +91,76 @@ class TestGate:
         assert v["checks"][0]["ok"]
 
 
+def _ht_row(host_frac, compile_1k, gc_p99, digest="dH"):
+    return {"stage": "host_tax", "host_fraction": host_frac,
+            "device_fraction": round(1.0 - host_frac, 3),
+            "compile_events": 4,
+            "compile_events_per_1k_batches": compile_1k,
+            "gc_pause_p99_ms": gc_p99, "coverage": 1.0,
+            "config_digest": digest}
+
+
+HT_HIST = [_ht_row(0.70 + 0.01 * i, 10.0 + i, 1.0 + 0.1 * i)
+           for i in range(4)]
+HT_SPEC = perf_gate._STAGE_METRICS["host_tax"]
+
+
+class TestHostTaxStage:
+    """[ISSUE 14] the host-tax budget: host-fraction up, compile
+    events per 1k batches up, or the GC tail up = breach."""
+
+    def test_stage_registered_and_default(self):
+        assert "host_tax" in perf_gate._STAGE_METRICS
+        assert "host_tax" in perf_gate._DEFAULT_STAGES
+
+    def test_within_budget_passes(self):
+        v = perf_gate.gate(HT_HIST + [_ht_row(0.72, 12.0, 1.1)],
+                           0.15, 4.0, 2, metrics=HT_SPEC)
+        assert v["ok"], v["checks"]
+
+    def test_host_fraction_up_breaches(self):
+        # the silent regression this stage exists for: throughput can
+        # stay in band while the host share climbs
+        v = perf_gate.gate(HT_HIST + [_ht_row(0.99, 12.0, 1.1)],
+                           0.15, 4.0, 2, metrics=HT_SPEC)
+        assert not v["ok"]
+        assert [c["metric"] for c in v["checks"] if not c["ok"]] == \
+            ["host_fraction"]
+
+    def test_compile_churn_up_breaches(self):
+        v = perf_gate.gate(HT_HIST + [_ht_row(0.71, 300.0, 1.1)],
+                           0.15, 4.0, 2, metrics=HT_SPEC)
+        assert not v["ok"]
+        assert [c["metric"] for c in v["checks"] if not c["ok"]] == \
+            ["compile_events_per_1k"]
+
+    def test_gc_tail_up_breaches(self):
+        v = perf_gate.gate(HT_HIST + [_ht_row(0.71, 12.0, 50.0)],
+                           0.15, 4.0, 2, metrics=HT_SPEC)
+        assert not v["ok"]
+        assert [c["metric"] for c in v["checks"] if not c["ok"]] == \
+            ["gc_pause_p99_ms"]
+
+    def test_missing_gc_metric_passes_vacuously(self):
+        # runs with zero GC pauses record None — no history, no gate
+        hist = [dict(_ht_row(0.70, 10.0, None)) for _ in range(3)]
+        v = perf_gate.gate(hist + [_ht_row(0.71, 11.0, None)],
+                           0.15, 4.0, 2, metrics=HT_SPEC)
+        assert v["ok"]
+
+    def test_main_gates_host_tax_rows(self, tmp_path, capsys):
+        p = str(tmp_path / "serving.jsonl")
+        _write(p, HT_HIST + [_ht_row(0.99, 12.0, 1.1)])
+        rc = perf_gate.main(["--history", p, "--mode", "fail",
+                             "--stage", "host_tax",
+                             "--tolerance-frac", "0.15",
+                             "--out", str(tmp_path / "v.jsonl")])
+        assert rc == 1
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        verdict = json.loads(out)
+        assert not verdict["stages"]["host_tax"]["ok"]
+
+
 class TestMain:
     def test_warn_mode_exits_zero_on_regression(self, tmp_path,
                                                 capsys):

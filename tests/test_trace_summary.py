@@ -1,0 +1,160 @@
+"""scripts/trace_summary.py [ISSUE 14 satellite]: the span-digest
+table and the new host-tax digest pinned against committed fixture
+files — the summarizer had zero test coverage while CI legs and
+RESULTS.md depended on its output."""
+
+import json
+import os
+
+import pytest
+
+from scripts.trace_summary import (
+    classify_frame, classify_stack, load_collapsed, load_spans,
+    summarize_collapsed, summarize_spans,
+)
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+SPANS = os.path.join(DATA, "trace_summary_spans.jsonl")
+COLLAPSED = os.path.join(DATA, "trace_summary_prof.collapsed")
+
+# the pinned span-digest table: self time = total minus DIRECT-child
+# time (request.insert owns 30ms total but its children tile it ->
+# 0 self), quantiles linear-interpolated over the retained samples
+EXPECTED_SPAN_TABLE = """\
+trace: {path}
+spans: 7  traces: 3  span window: 0.038s
+
+span (by self time)                      n    self_ms   total_ms    p99_ms
+insert.index_insert                      2      21.00      21.00    14.910
+insert.queue_wait                        2       9.00       9.00     4.990
+compaction.sync                          1       8.00       8.00     8.000
+request.insert                           2       0.00      30.00    19.900
+
+insert stage                   n    p50_ms    p99_ms    max_ms
+insert.index_insert            2    10.500    14.910    15.000
+insert.queue_wait              2     4.500     4.990     5.000"""
+
+# the pinned host-tax digest: 100 samples classified leaf-first
+EXPECTED_HOST_TAX_TABLE = """\
+profile: {path}
+samples: 100  distinct stacks: 5
+
+host-tax category         samples   share
+serving_python                 40  40.0%
+jax_dispatch                   25  25.0%
+wait_idle                      20  20.0%
+numpy_host                     10  10.0%
+mesh_glue                       5   5.0%
+
+top leaf frame                                        samples   share
+tuplewise_tpu/serving/index.py:insert_batch                40  40.0%
+jax/_src/pjit.py:__call__                                  25  25.0%
+lib/python3.11/threading.py:wait                           20  20.0%"""
+
+
+class TestSpanDigest:
+    def test_pinned_table(self):
+        assert summarize_spans(SPANS, 5) == EXPECTED_SPAN_TABLE.format(
+            path=SPANS)
+
+    def test_load_spans_skips_meta(self):
+        spans = load_spans(SPANS)
+        assert len(spans) == 7
+        assert all("meta" not in s for s in spans)
+
+    def test_chrome_export_same_digest(self, tmp_path):
+        # the Chrome trace-event shape must digest identically (modulo
+        # the header line naming the file)
+        spans = load_spans(SPANS)
+        doc = {"traceEvents": [
+            {"ph": "X", "name": s["name"], "pid": 1, "tid": 1,
+             "ts": s["t0_s"] * 1e6, "dur": s["dur_s"] * 1e6,
+             "args": {"trace_id": s["trace_id"],
+                      "span_id": s["span_id"],
+                      **({"parent_id": s["parent_id"]}
+                         if s["parent_id"] is not None else {})}}
+            for s in spans]}
+        p = str(tmp_path / "trace.json")
+        with open(p, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        got = summarize_spans(p, 5).splitlines()[1:]
+        assert got == EXPECTED_SPAN_TABLE.format(
+            path=SPANS).splitlines()[1:]
+
+    def test_empty_input_raises(self, tmp_path):
+        p = str(tmp_path / "empty.jsonl")
+        with open(p, "w", encoding="utf-8") as f:
+            f.write('{"meta": {}}\n')
+        with pytest.raises(ValueError):
+            summarize_spans(p)
+
+
+class TestHostTaxDigest:
+    def test_pinned_table(self):
+        assert summarize_collapsed(COLLAPSED, 3) == \
+            EXPECTED_HOST_TAX_TABLE.format(path=COLLAPSED)
+
+    def test_load_collapsed(self):
+        stacks = dict(load_collapsed(COLLAPSED))
+        assert sum(stacks.values()) == 100
+        assert all(st[0].startswith("thread:") for st in stacks)
+
+    def test_classification_leaf_first(self):
+        # a numpy sort called FROM serving code is numpy time
+        assert classify_stack(
+            ("thread:x", "tuplewise_tpu/serving/index.py:_merge",
+             "numpy/_core/fromnumeric.py:sort")) == "numpy_host"
+        # an unclassifiable leaf falls back toward the root
+        assert classify_stack(
+            ("thread:x", "tuplewise_tpu/serving/engine.py:_run",
+             "lib/python3.11/json/encoder.py:encode")) \
+            == "serving_python"
+        assert classify_stack(("thread:x", "mystery.py:f")) \
+            == "other_host"
+
+    def test_wait_beats_serving(self):
+        # a serving thread blocked in queue.get is WAITING, not serving
+        assert classify_frame("lib/python3.11/queue.py:get") \
+            == "wait_idle"
+        assert classify_stack(
+            ("thread:b", "tuplewise_tpu/serving/engine.py:_run",
+             "lib/python3.11/queue.py:get")) == "wait_idle"
+
+    def test_recovery_is_io_not_serving(self):
+        assert classify_frame(
+            "tuplewise_tpu/serving/recovery.py:record") \
+            == "wal_snapshot_io"
+
+    def test_speedscope_input(self, tmp_path):
+        # the speedscope export digests to the same category split
+        frames = []
+        index = {}
+        samples, weights = [], []
+        for stack, n in load_collapsed(COLLAPSED):
+            ixs = []
+            for fr in stack:
+                if fr not in index:
+                    index[fr] = len(frames)
+                    frames.append({"name": fr})
+                ixs.append(index[fr])
+            for _ in range(n):
+                samples.append(ixs)
+                weights.append(0.01)
+        doc = {"$schema":
+               "https://www.speedscope.app/file-format-schema.json",
+               "shared": {"frames": frames},
+               "profiles": [{"type": "sampled", "unit": "seconds",
+                             "startValue": 0, "endValue": sum(weights),
+                             "samples": samples, "weights": weights}]}
+        p = str(tmp_path / "prof.speedscope.json")
+        with open(p, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        table = summarize_collapsed(p, 3)
+        assert "serving_python                 40  40.0%" in table
+        assert "samples: 100" in table
+
+    def test_empty_profile_raises(self, tmp_path):
+        p = str(tmp_path / "empty.collapsed")
+        open(p, "w").close()
+        with pytest.raises(ValueError):
+            summarize_collapsed(p)

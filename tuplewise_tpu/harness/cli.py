@@ -218,6 +218,14 @@ def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
                 meta={"stage": "serve"}, config=cfg,
                 observers=([slo_monitor.observe_row]
                            if slo_monitor is not None else ())).start()
+        profiler = None
+        if obs is not None and (getattr(obs, "prof", False)
+                                or getattr(obs, "prof_out", None)):
+            # host-tax sampling profiler [ISSUE 14]: hard-off unless
+            # asked for; the overhead guard keeps it <= 5%
+            from tuplewise_tpu.obs.prof import SamplingProfiler
+
+            profiler = SamplingProfiler(metrics=eng.metrics).start()
         with _jax_trace(obs.profile_dir if obs is not None else None):
             for line in sys.stdin:
                 line = line.strip()
@@ -295,6 +303,8 @@ def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     resp = {"ok": False, "error": f"bad request: {e}"}
                 print(json.dumps(resp), flush=True)
+        if profiler is not None:
+            profiler.stop()
         if flusher is not None:
             flusher.stop()
         stats = eng.stats()
@@ -317,6 +327,13 @@ def _serve_stdin(cfg, chaos=None, obs=None, tenancy=None) -> int:
                              slo=slo_monitor)
     if controller is not None:
         summary["controller"] = controller.state()
+    if profiler is not None:
+        from tuplewise_tpu.obs.prof import export_profile
+
+        summary["prof_out"] = export_profile(
+            profiler, getattr(obs, "prof_out", None))
+        summary["prof_samples"] = profiler.samples
+        summary["prof_overhead_fraction"] = profiler.overhead_fraction()
     print(json.dumps({"exit_summary": summary}), file=sys.stderr)
     print(json.dumps({"final_stats": m}), file=sys.stderr)
     return 0
@@ -506,6 +523,25 @@ def main(argv=None) -> int:
         p.add_argument("--flight-out", type=str, default=None,
                        help="dump the flight recorder (JSONL) here on "
                             "exit")
+        p.add_argument("--tail-exemplar-ms", type=float, default=None,
+                       help="tail exemplars [ISSUE 14]: an insert "
+                            "whose measured latency reaches this "
+                            "threshold auto-captures its full host-tax"
+                            " ledger + trace id as a tail_exemplar "
+                            "flight event (p99 forensics in one dump);"
+                            " default: never")
+        p.add_argument("--prof", action="store_true",
+                       help="host-tax sampling profiler [ISSUE 14]: "
+                            "periodic folded Python stacks of every "
+                            "thread, <= 5%% guarded overhead (the "
+                            "sampling interval widens itself past the "
+                            "guard); hard-off without this flag")
+        p.add_argument("--prof-out", type=str, default=None,
+                       help="write the profile here (implies --prof): "
+                            "*.collapsed/*.txt = folded stacks "
+                            "(flamegraph/speedscope paste), anything "
+                            "else = speedscope JSON; digest either "
+                            "with scripts/trace_summary.py")
         p.add_argument("--slo-spec", type=str, default=None,
                        help="declarative SLO objectives (JSON inline, "
                             "@file, or *.json — obs.slo spec schema, "
@@ -696,6 +732,7 @@ def main(argv=None) -> int:
             snapshot_every=args.snapshot_every, recover=args.recover,
             wal_fsync=args.wal_fsync,
             flight_recorder_size=args.flight_recorder_size,
+            tail_exemplar_ms=args.tail_exemplar_ms,
             seed=args.seed,
         )
         chaos = None
@@ -754,7 +791,9 @@ def main(argv=None) -> int:
                        profile_dir=args.profile_dir,
                        flight_out=args.flight_out,
                        slo_spec=args.slo_spec,
-                       controller_spec=args.controller_spec),
+                       controller_spec=args.controller_spec,
+                       prof=args.prof or None,
+                       prof_out=args.prof_out),
                 args.out,
             )
             return 0

@@ -53,14 +53,33 @@ into verdicts:
                               verdicts, fault->recovery correlation,
                               top self-time spans, one machine-
                               readable verdict line for CI.
+
+[ISSUE 14] adds the host-tax accounting layer (DESIGN §18):
+
+* ``ledger.WaveLedger``     — per-micro-batch wall-clock ledger:
+                              exhaustive non-overlapping buckets
+                              (host Python / dispatch / device
+                              compute / XLA compile / GC pause /
+                              lock+queue wait) whose sums tile the
+                              measured insert latency exactly;
+                              ``device_section`` is the dispatch-
+                              boundary hook.
+* ``prof.SamplingProfiler`` — hard-off folded-stack sampler with a
+                              <= 5% guarded overhead; exports
+                              collapsed-stack and speedscope files
+                              digested by ``scripts/trace_summary.py``.
 """
 
 from tuplewise_tpu.obs.flight import FlightRecorder
 from tuplewise_tpu.obs.health import (
     DriftDetector, EstimateHealth, shard_balance,
 )
+from tuplewise_tpu.obs.ledger import WaveLedger, device_section
 from tuplewise_tpu.obs.metrics_export import MetricsFlusher, config_digest
-from tuplewise_tpu.obs.report import recovery_counters, service_report
+from tuplewise_tpu.obs.prof import SamplingProfiler
+from tuplewise_tpu.obs.report import (
+    host_tax_block, recovery_counters, service_report,
+)
 from tuplewise_tpu.obs.slo import SloMonitor, SloSpec, evaluate_history
 from tuplewise_tpu.obs.tracing import Span, Tracer
 
@@ -69,12 +88,16 @@ __all__ = [
     "EstimateHealth",
     "FlightRecorder",
     "MetricsFlusher",
+    "SamplingProfiler",
     "SloMonitor",
     "SloSpec",
     "Span",
     "Tracer",
+    "WaveLedger",
     "config_digest",
+    "device_section",
     "evaluate_history",
+    "host_tax_block",
     "recovery_counters",
     "service_report",
     "shard_balance",

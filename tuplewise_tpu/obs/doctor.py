@@ -21,6 +21,13 @@ act on:
 * **Top self-time spans** — where the wall-clock went (total minus
   direct-child time), so the breach and the hot path sit in one
   report.
+* **Host-tax verdicts** [ISSUE 14] — the wave ledger's final gauges
+  (host/device fraction, tiling coverage, compile + GC event counts)
+  judged against the compile-churn and GC-in-p99 thresholds; a
+  fallen-back count kernel (``count_kernel_fallbacks_total`` > 0) and
+  the pack full-replace counters surface under ``kernel`` — a kernel
+  serving correct counts through its XLA fallback used to read
+  "healthy".
 
 Verdict taxonomy (DESIGN §13):
 
@@ -55,6 +62,18 @@ _METRICS_NAMES = ("metrics.jsonl",)
 _FLIGHT_NAMES = ("flight.jsonl", "obs_flight.jsonl")
 _SPAN_NAMES = ("spans.jsonl", "obs_spans.jsonl", "trace.json",
                "obs_trace.json")
+
+# host-tax verdict thresholds [ISSUE 14] (override via diagnose's
+# ``context``): a steady-state service averaging MORE THAN ONE XLA
+# compile per batch on its request thread has lost the prewarm/ladder
+# discipline outright; a GC pause distribution whose p99 rivals the
+# insert p99 means the collector IS the tail. Both are generous
+# enough that the healthy CI smokes (short, warmup-free, so they DO
+# pay their first-call ladder compiles inside the measured window)
+# clear; a long-running serve should gate far tighter via context.
+COMPILE_CHURN_PER_1K_BATCHES = 1000.0
+GC_P99_FRACTION_OF_INSERT = 0.5
+GC_MIN_PAUSES = 10
 
 
 def load_metrics_rows(path: str) -> List[dict]:
@@ -216,7 +235,14 @@ def correlate_faults(flight_events: List[dict], metrics_rows: List[dict],
             "trace_span": _span_for_trace(spans, e.get("trace_id")),
         }
         resolution = evidence = None
-        if point == "batcher":
+        if e.get("action") == "delay":
+            # a latency injection needs no recovery machinery — the
+            # engine absorbs the stall; when tail exemplars fired
+            # [ISSUE 14], THEY are the evidence the stall was seen
+            resolution = "latency_absorbed"
+            n_ex = len(by_kind.get("tail_exemplar", ()))
+            evidence = ({"tail_exemplars": n_ex} if n_ex else None)
+        elif point == "batcher":
             r = _after("batcher_restart", e["seq"])
             if r is not None:
                 resolution = "batcher_restart"
@@ -427,6 +453,42 @@ def diagnose(metrics_path: Optional[str] = None,
     }
     report["health"] = health
 
+    # host-tax ledger [ISSUE 14]: where the insert wall-clock went,
+    # judged against the compile-churn / GC-tail thresholds (None and
+    # omitted for pre-ledger artifacts)
+    from tuplewise_tpu.obs.report import host_tax_block
+
+    host_tax = host_tax_block(m) if m else None
+    if host_tax is not None:
+        ctx = context or {}
+        churn_max = ctx.get("compile_churn_per_1k_batches",
+                            COMPILE_CHURN_PER_1K_BATCHES)
+        gc_frac = ctx.get("gc_p99_fraction_of_insert",
+                          GC_P99_FRACTION_OF_INSERT)
+        churn = host_tax.get("compile_events_per_1k_batches")
+        host_tax["compile_churn"] = bool(
+            churn is not None and churn > churn_max)
+        ins_p99 = m.get("insert_latency_s", {}).get("p99")
+        gc_p99_ms = host_tax.get("gc_pause_p99_ms")
+        host_tax["gc_in_p99"] = bool(
+            ins_p99 and gc_p99_ms is not None
+            and (host_tax.get("gc_pauses") or 0) >= GC_MIN_PAUSES
+            and gc_p99_ms >= gc_frac * ins_p99 * 1e3)
+        report["host_tax"] = host_tax
+
+    # silently-degraded serving paths [ISSUE 14 satellite]: a fallen-
+    # back count kernel or a fleet stuck re-shipping full packs used
+    # to read "healthy" because nothing surfaced the counters
+    kernel = {
+        "count_kernel_calls": _g("count_kernel_calls_total") or 0,
+        "count_kernel_fallbacks": _g("count_kernel_fallbacks_total")
+        or 0,
+        "pack_replaces": _g("pack_replaces_total") or 0,
+        "pack_full_replaces": _g("pack_full_replaces_total") or 0,
+    }
+    if any(kernel.values()):
+        report["kernel"] = kernel
+
     # per-tenant breakdown [ISSUE 8]: fleet runs carry tenant-labeled
     # metrics; surface them grouped so the doctor answers "WHICH
     # tenant" in one read (None and omitted for single-tenant runs)
@@ -474,6 +536,18 @@ def _verdict(report: dict, kinds: dict) -> str:
         degraded.append("heal_exhausted")
     if kinds.get("snapshot_error"):
         degraded.append("snapshot_error")
+    # host-tax verdicts [ISSUE 14]: steady-state compiles on the
+    # request thread / a GC tail rivaling the insert p99
+    host_tax = report.get("host_tax")
+    if host_tax is not None:
+        if host_tax.get("compile_churn"):
+            degraded.append("compile_on_request_thread")
+        if host_tax.get("gc_in_p99"):
+            degraded.append("gc_in_p99")
+    # a fallen-back count kernel serves correct counts SLOWLY — that
+    # is degradation, not health [ISSUE 14 satellite]
+    if (report.get("kernel") or {}).get("count_kernel_fallbacks"):
+        degraded.append("count_kernel_fallback")
     unresolved = [f for f in report["faults"] if not f["resolved"]]
     if unresolved:
         degraded.append(f"{len(unresolved)}_unresolved_faults")
@@ -510,6 +584,10 @@ def verdict_line(report: dict) -> dict:
         "drift_alerts": report["health"]["drift_alerts"],
         "actuations": acts.get("total", 0),
         "actuations_attributed": acts.get("attributed", 0),
+        # the headline host-tax number [ISSUE 14]: the fraction the
+        # one-dispatch refactor exists to move (None pre-ledger)
+        "host_fraction": (report.get("host_tax")
+                          or {}).get("host_fraction"),
     }
 
 

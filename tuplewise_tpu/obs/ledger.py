@@ -1,0 +1,303 @@
+"""Host-tax wave ledger [ISSUE 14 tentpole]: attribute every
+microsecond of the insert request path.
+
+The bench record is blunt — device counts are microseconds while CPU
+insert p99 sits at milliseconds — and the ROADMAP's one-dispatch
+serving core is justified entirely by the claim that host-side Python
+dominates. This module *measures* that split: each insert micro-batch
+("wave") decomposes its wall time into exhaustive, non-overlapping
+buckets
+
+* ``queue_wait``     — enqueue → batcher pickup (per request),
+* ``lock_wait``      — waiting on the engine's estimator lock,
+* ``host_python``    — plan assembly, per-tenant dict hops,
+                       splice-merge, WAL append: everything on the
+                       request thread that is neither a device call
+                       nor a GC pause,
+* ``dispatch``       — inside the jitted call until it returns (on
+                       TPU: enqueue-only; on CPU jax, execution is
+                       largely synchronous, so inline compute lands
+                       here — see DESIGN §18),
+* ``device_compute`` — from dispatch return to the blocking
+                       host-transfer boundary (``np.asarray`` /
+                       ``block_until_ready``),
+* ``xla_compile``    — a dispatch whose (function, shape-ladder) key
+                       was never seen before: the first-call / ladder-
+                       growth compile, the runtime twin of the
+                       ``compile_ladder`` static pass,
+* ``gc_pause``       — cyclic-GC pauses on the wave's thread
+                       (``gc.callbacks``),
+
+with a hard invariant: per-request bucket sums equal the measured
+insert latency EXACTLY (``host_python`` is the remainder after the
+directly-measured buckets, so the tiling is 1.0 by construction — the
+PR 6 stage-attribution discipline extended below the stage level).
+
+Wiring: the engine opens a wave on its batcher thread
+(:meth:`WaveLedger.begin_wave`); the dispatch boundaries in
+``serving/index.py`` and ``parallel/sharded_counts.py`` wrap their
+jitted calls in :func:`device_section` — a thread-local lookup, so a
+dispatch outside any wave (compactor builds, prewarm compiles, score
+waves) costs one ``getattr`` and records nothing. Compile detection is
+first-seen per dispatch key in a process-global set, mirroring the
+process-global jit caches: a warmed engine correctly reports zero
+request-thread compiles.
+
+Metrics (all through the engine's ``MetricsRegistry``, so they ride
+``MetricsFlusher`` / SLO / doctor for free): ``host_tax_<bucket>_s``
+histograms, ``host_tax_host_fraction`` / ``host_tax_device_fraction``
+gauges, ``xla_compile_events_total`` / ``gc_pauses_total`` counters,
+and a ``gc_pause_s`` histogram of individual pauses.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+import time
+from typing import Dict, List, Optional
+
+# bucket order is the tiling order the report/table renders
+BUCKETS = ("queue_wait", "lock_wait", "host_python", "dispatch",
+           "device_compute", "xla_compile", "gc_pause")
+
+# thread-local active wave: device_section / the gc hook look it up
+_ACTIVE = threading.local()
+
+# process-global first-seen dispatch keys — compile caches (lru_cached
+# jit factories, jax's own cache) are process-global, so "first call
+# with this key" must be too; the lock serializes concurrent engines
+_SEEN_LOCK = threading.Lock()
+_SEEN: set = set()
+
+_GC_LOCK = threading.Lock()
+_GC_INSTALLED = False
+
+
+def reset_seen() -> None:
+    """Forget every seen dispatch key (tests: make compile-event
+    classification deterministic per test)."""
+    with _SEEN_LOCK:
+        _SEEN.clear()
+
+
+def _note_key(key) -> bool:
+    """True exactly once per key process-wide (=> compile event)."""
+    with _SEEN_LOCK:
+        if key in _SEEN:
+            return False
+        _SEEN.add(key)
+        return True
+
+
+def _gc_hook(phase, info) -> None:
+    """gc.callbacks hook: bill collection pauses to the active wave of
+    the thread the collection ran on. Collections on non-wave threads
+    (flusher, compactor) record nothing — they never pause the request
+    path."""
+    wave = getattr(_ACTIVE, "wave", None)
+    if wave is None:
+        return
+    if phase == "start":
+        wave._gc_t0 = time.perf_counter()
+    elif wave._gc_t0 is not None:
+        wave.gc_pauses.append(time.perf_counter() - wave._gc_t0)
+        wave._gc_t0 = None
+
+
+def _ensure_gc_hook() -> None:
+    global _GC_INSTALLED
+    with _GC_LOCK:
+        if not _GC_INSTALLED:
+            gc.callbacks.append(_gc_hook)
+            _GC_INSTALLED = True
+
+
+class _Wave:
+    """Accumulator for one insert micro-batch; thread-confined to the
+    batcher thread that opened it."""
+
+    __slots__ = ("dispatch_s", "compute_s", "compile_s",
+                 "compile_events", "gc_pauses", "_gc_t0")
+
+    def __init__(self):
+        self.dispatch_s = 0.0
+        self.compute_s = 0.0
+        self.compile_s = 0.0
+        self.compile_events = 0
+        self.gc_pauses: List[float] = []
+        self._gc_t0: Optional[float] = None
+
+
+class _DeviceSection:
+    """Context manager wrapping one device dispatch::
+
+        with device_section(("count", bb, qb)) as ds:
+            out = jit_fn(args)      # dispatch (compile on first key)
+            ds.dispatched()         # the call returned
+            host = np.asarray(out)  # device compute + d2h, blocking
+
+    [enter, dispatched] bills ``dispatch`` (or ``xla_compile`` when
+    the key is first-seen); [dispatched, exit] bills
+    ``device_compute``. No active wave on this thread => pure no-op.
+    """
+
+    __slots__ = ("_key", "_wave", "_t0", "_t_disp")
+
+    def __init__(self, key):
+        self._key = key
+        self._wave = None
+        self._t0 = 0.0
+        self._t_disp = None
+
+    def __enter__(self) -> "_DeviceSection":
+        self._wave = getattr(_ACTIVE, "wave", None)
+        if self._wave is not None:
+            self._t_disp = None
+            self._t0 = time.perf_counter()
+        return self
+
+    def dispatched(self) -> None:
+        if self._wave is not None:
+            self._t_disp = time.perf_counter()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        w = self._wave
+        if w is not None:
+            t1 = time.perf_counter()
+            td = self._t_disp if self._t_disp is not None else t1
+            if _note_key(self._key):
+                w.compile_s += td - self._t0
+                w.compile_events += 1
+            else:
+                w.dispatch_s += td - self._t0
+            w.compute_s += max(0.0, t1 - td)
+            self._wave = None
+        return False
+
+
+def device_section(key) -> _DeviceSection:
+    """The one-line hook every dispatch boundary uses. ``key`` must be
+    hashable and identify the compiled artifact (function family +
+    every shape/ladder/mesh input of its jit cache key)."""
+    return _DeviceSection(key)
+
+
+class WaveLedger:
+    """Per-engine host-tax accounting over insert waves.
+
+    Always-on (unlike the sampling profiler): a wave costs a handful
+    of ``perf_counter`` readings on top of the stage attribution the
+    engine already pays, and the tiling invariant is the contract the
+    perf gate and obs smoke assert on every run.
+    """
+
+    def __init__(self, metrics):
+        self._h = {b: metrics.histogram(f"host_tax_{b}_s")
+                   for b in BUCKETS}
+        self._g_host = metrics.gauge("host_tax_host_fraction")
+        self._g_dev = metrics.gauge("host_tax_device_fraction")
+        self._c_compile = metrics.counter("xla_compile_events_total")
+        self._c_gc = metrics.counter("gc_pauses_total")
+        self._h_gc = metrics.histogram("gc_pause_s")
+        self._c_waves = metrics.counter("host_tax_waves_total")
+        # cumulative seconds for the fraction gauges; written only on
+        # the batcher thread (finish_wave), read via the gauges
+        self._host_s = 0.0
+        self._device_s = 0.0
+        self._total_s = 0.0
+        _ensure_gc_hook()
+
+    # ------------------------------------------------------------------ #
+    def begin_wave(self) -> _Wave:
+        """Open a wave on THIS thread; device sections and GC pauses
+        on this thread now bill to it. Pair with :meth:`finish_wave`
+        (or :meth:`abort_wave` on the failure path)."""
+        w = _Wave()
+        _ACTIVE.wave = w
+        return w
+
+    def abort_wave(self, wave: _Wave) -> None:
+        """Clear the thread-local binding without recording — the wave
+        failed and its requests got exceptions, not latencies."""
+        if getattr(_ACTIVE, "wave", None) is wave:
+            _ACTIVE.wave = None
+
+    def finish_wave(self, wave: _Wave, *, t_start: float,
+                    t_end: float, queue_waits,
+                    t_lock_req: Optional[float] = None,
+                    t_lock: Optional[float] = None) -> Dict[str, float]:
+        """Close the wave and bill its buckets.
+
+        ``queue_waits``: one enqueue→pickup interval per request in
+        the wave (each request's measured insert latency is its
+        queue_wait plus the shared [t_start, t_end] wave time, and the
+        buckets tile exactly that). ``t_lock_req``/``t_lock`` bound
+        the estimator-lock acquisition; omitted (fleet path) the lock
+        wait stays inside ``host_python``. Returns this wave's bucket
+        values (without the per-request queue_wait) — the tail-
+        exemplar payload.
+        """
+        if getattr(_ACTIVE, "wave", None) is wave:
+            _ACTIVE.wave = None
+        total = max(0.0, t_end - t_start)
+        lock_wait = 0.0
+        if t_lock_req is not None and t_lock is not None:
+            lock_wait = max(0.0, t_lock - t_lock_req)
+        gc_s = sum(wave.gc_pauses)
+        direct = (lock_wait + wave.dispatch_s + wave.compute_s
+                  + wave.compile_s + gc_s)
+        host_py = total - direct
+        if host_py < 0.0:
+            # a GC pause can overlap a device section (the collection
+            # triggered inside dispatch-side Python): shave the
+            # overlap off the gc bucket first, then off dispatch, so
+            # the tiling stays exact instead of summing past 100%
+            deficit = -host_py
+            shaved = min(gc_s, deficit)
+            gc_s -= shaved
+            deficit -= shaved
+            wave.dispatch_s = max(0.0, wave.dispatch_s - deficit)
+            host_py = 0.0
+        n = len(queue_waits)
+        h = self._h
+        qw_sum = sum(queue_waits)
+        h["queue_wait"].observe_many(queue_waits)
+        if n:
+            # wave-shared buckets bill weighted (sum exact, ONE ring
+            # sample per wave): observe_n's per-request sample copies
+            # cost ~3-4% of serving throughput at max_batch fill, and
+            # the host-tax p99 table wants the per-wave distribution
+            # anyway. Zero-valued buckets still contribute their
+            # (zero) weight so counts stay per-request everywhere.
+            h["lock_wait"].observe_weighted(lock_wait, n)
+            h["host_python"].observe_weighted(host_py, n)
+            h["dispatch"].observe_weighted(wave.dispatch_s, n)
+            h["device_compute"].observe_weighted(wave.compute_s, n)
+            h["xla_compile"].observe_weighted(wave.compile_s, n)
+            h["gc_pause"].observe_weighted(gc_s, n)
+        if wave.compile_events:
+            self._c_compile.inc(wave.compile_events)
+        if wave.gc_pauses:
+            self._c_gc.inc(len(wave.gc_pauses))
+            for p in wave.gc_pauses:
+                self._h_gc.observe(p)
+        self._c_waves.inc()
+        # fraction gauges: host = everything that is not device
+        # compute or compile — queue/lock waits, Python, dispatch
+        # glue, GC; the split the one-dispatch refactor must move
+        self._host_s += qw_sum + n * (lock_wait + host_py
+                                      + wave.dispatch_s + gc_s)
+        self._device_s += n * wave.compute_s
+        self._total_s += qw_sum + n * total
+        if self._total_s > 0:
+            self._g_host.set(self._host_s / self._total_s)
+            self._g_dev.set(self._device_s / self._total_s)
+        return {
+            "lock_wait": lock_wait,
+            "host_python": host_py,
+            "dispatch": wave.dispatch_s,
+            "device_compute": wave.compute_s,
+            "xla_compile": wave.compile_s,
+            "gc_pause": gc_s,
+        }

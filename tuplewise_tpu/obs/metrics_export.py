@@ -48,7 +48,8 @@ from typing import Optional
 # not orphan the perf gate's committed history [ISSUE 10 satellite].
 # A NON-default value still lands in the blob (different config =>
 # different digest, as it should).
-_ADDITIVE_DEFAULTS = {"count_kernel": False}
+_ADDITIVE_DEFAULTS = {"count_kernel": False,
+                      "tail_exemplar_ms": None}
 
 
 def config_digest(config) -> str:
@@ -122,6 +123,12 @@ class MetricsFlusher:
         self._lock = threading.Lock()    # serializes appends
         self._f = None
         self.last_flush_error: Optional[str] = None
+        # wedged-observer escape hatch [ISSUE 14 bugfix]: when stop()
+        # gives up waiting on a flush stuck inside a slow observer,
+        # the in-flight flush becomes the final row and closes the
+        # file itself; the counter makes the event observable
+        self._late = threading.Event()
+        self._c_late = registry.counter("flusher_late_flushes_total")
 
     # ------------------------------------------------------------------ #
     def flush(self) -> int:
@@ -161,6 +168,12 @@ class MetricsFlusher:
                     obs(row)
                 except Exception as e:   # noqa: BLE001 — see docstring
                     self.last_flush_error = repr(e)
+            if self._late.is_set() and self._f is not None:
+                # stop() already returned without the final close
+                # (this very flush was wedged in an observer): the
+                # row above is the final row; release the file here
+                self._f.close()
+                self._f = None
             return self._seq
 
     def _run(self) -> None:
@@ -178,9 +191,29 @@ class MetricsFlusher:
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
+        """Stop the flusher thread and write the final row.
+
+        [ISSUE 14 bugfix] The final flush used to race a wedged
+        observer: observers run under the flush lock, so a stop()
+        while an observer hangs would block on that lock FOREVER
+        (shutdown wedged behind the very observer the flusher exists
+        to tolerate). Now the join is bounded: if the thread is still
+        mid-flush after ``timeout``, stop() counts a
+        ``flusher_late_flushes_total``, marks the in-flight flush as
+        the final one (it closes the file when it completes), and
+        returns — shutdown never inherits an observer's hang."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                self._c_late.inc()
+                self.last_flush_error = (
+                    "stop(): flusher thread still mid-flush after "
+                    f"{timeout}s (wedged observer?) — final flush "
+                    "left to the in-flight one")
+                self._late.set()
+                return
             self._thread = None
         self.flush()         # final row: the exit state
         with self._lock:

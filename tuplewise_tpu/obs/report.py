@@ -39,7 +39,24 @@ _RECOVERY_COUNTERS = (
     "major_merge_fallbacks",
     "poison_rejects",
     "deadline_expired_total",
+    # a MetricsFlusher.stop() that gave up waiting on a wedged
+    # observer and left the in-flight flush to finish late [ISSUE 14
+    # bugfix] — nonzero means an observer is slow enough to eat the
+    # shutdown timeout
+    "flusher_late_flushes_total",
 )
+
+# the host-tax bucket taxonomy [ISSUE 14]: the below-stage-level
+# decomposition obs.ledger.WaveLedger bills (DESIGN §18). Kept here —
+# next to INSERT_STAGES — so the report builder and the ledger can
+# never disagree about the bucket set.
+HOST_TAX_BUCKETS = ("queue_wait", "lock_wait", "host_python",
+                    "dispatch", "device_compute", "xla_compile",
+                    "gc_pause")
+
+
+def host_tax_metric(bucket: str) -> str:
+    return f"host_tax_{bucket}_s"
 
 
 def _v(m: dict, name: str):
@@ -87,6 +104,48 @@ def stage_attribution(metrics: dict) -> Optional[dict]:
     }
 
 
+def host_tax_block(metrics: dict) -> Optional[dict]:
+    """The host-tax ledger summary [ISSUE 14]: fractions, coverage,
+    compile/GC event counts, and per-bucket p99s — the block the serve
+    exit summary, replay records, ``bench.py --streaming`` and the
+    doctor all render from ONE builder. ``coverage`` is bucket sums
+    over measured ``insert_latency_s`` sums: 1.0 up to float rounding
+    by construction (the ledger's tiling invariant); materially less
+    means an unattributed interval crept into the wave path. None when
+    the snapshot predates the ledger (no waves recorded)."""
+    if not metrics.get("host_tax_waves_total", {}).get("value"):
+        return None
+    total = metrics.get("insert_latency_s", {})
+    attributed = sum(
+        metrics.get(host_tax_metric(b), {}).get("sum", 0.0)
+        for b in HOST_TAX_BUCKETS)
+    batches = _v(metrics, "batches_total")
+    compile_events = _v(metrics, "xla_compile_events_total")
+    p99 = {}
+    for b in HOST_TAX_BUCKETS:
+        p = _p_ms(metrics, host_tax_metric(b), "p99")
+        if p is not None:
+            p99[b] = p
+    return {
+        "host_fraction": metrics.get(
+            "host_tax_host_fraction", {}).get("value"),
+        "device_fraction": metrics.get(
+            "host_tax_device_fraction", {}).get("value"),
+        "coverage": ((attributed / total["sum"])
+                     if total.get("sum") else None),
+        "attributed_s": attributed,
+        "measured_s": total.get("sum", 0.0),
+        "waves": _v(metrics, "host_tax_waves_total"),
+        "compile_events": compile_events,
+        "compile_events_per_1k_batches": (
+            1e3 * compile_events / batches if batches else None),
+        "gc_pauses": _v(metrics, "gc_pauses_total"),
+        "gc_pause_p99_ms": _p_ms(metrics, "gc_pause_s", "p99"),
+        "tail_exemplars": _v(metrics, "tail_exemplars_total"),
+        "bucket_p99_ms": p99,
+    }
+
+
 def service_report(metrics: dict, chaos=None,
                    flight=None, slo=None) -> dict:
     """The shared serving report: load-shedding, compaction, transfer,
@@ -115,6 +174,9 @@ def service_report(metrics: dict, chaos=None,
                                        "p99"),
         "insert_stage_p99_ms": stage_p99_ms(metrics),
         "stage_attribution": stage_attribution(metrics),
+        # host-tax ledger [ISSUE 14]: None on snapshots that predate
+        # the ledger (old metrics.jsonl rows stay diagnosable)
+        "host_tax": host_tax_block(metrics),
         "bytes_h2d": _v(metrics, "bytes_h2d"),
         "bytes_h2d_saved": _v(metrics, "bytes_h2d_saved"),
         "major_merges_total": _v(metrics, "major_merges_total"),

@@ -92,6 +92,8 @@ from typing import NamedTuple, Sequence, Tuple
 
 import numpy as np
 
+from tuplewise_tpu.obs.ledger import device_section
+
 _MIN_BUCKET = 256
 
 
@@ -322,13 +324,18 @@ def sharded_counts(mesh, base_dev, cap: int, q: np.ndarray,
     if not runs:
         z = np.zeros(len(q), dtype=np.int64)
         return z, z
-    if len(runs) == 1:
-        less, leq = sharded_count_fn(mesh, caps[0], qb)(runs[0], q_p)
-    else:
-        less, leq = sharded_multi_count_fn(
-            mesh, tuple(caps), qb)(tuple(runs), q_p)
-    return (np.asarray(less)[: len(q)].astype(np.int64),
-            np.asarray(leq)[: len(q)].astype(np.int64))
+    # host-tax dispatch boundary [ISSUE 14]: key mirrors the jit
+    # factory cache key, so first-seen == ladder-growth compile
+    with device_section(("sharded_count", mesh, tuple(caps), qb)) as ds:
+        if len(runs) == 1:
+            less, leq = sharded_count_fn(mesh, caps[0], qb)(runs[0], q_p)
+        else:
+            less, leq = sharded_multi_count_fn(
+                mesh, tuple(caps), qb)(tuple(runs), q_p)
+        ds.dispatched()
+        less = np.asarray(less)[: len(q)].astype(np.int64)
+        leq = np.asarray(leq)[: len(q)].astype(np.int64)
+    return less, leq
 
 
 # --------------------------------------------------------------------- #
@@ -447,8 +454,14 @@ def signed_pair_counts(mesh, runs_a, runs_b, q_a: np.ndarray,
     key = (mesh, tuple(caps), tuple(signs), tuple(assign), qb_bucket)
 
     def _xla():
-        f = _xla_signed_pair_fn(mesh, key[1], key[2], key[3], qb_bucket)
-        return np.asarray(f(tuple(devs), qa_p, qb_p))
+        # host-tax dispatch boundary [ISSUE 14]; the key carries
+        # kernel=False so a post-fallback XLA compile still counts
+        with device_section(("signed_pair", key, False)) as ds:
+            f = _xla_signed_pair_fn(mesh, key[1], key[2], key[3],
+                                    qb_bucket)
+            raw = f(tuple(devs), qa_p, qb_p)
+            ds.dispatched()
+            return np.asarray(raw)
 
     if kernel is not None and key not in _KERNEL_BROKEN:
         try:
@@ -463,7 +476,10 @@ def signed_pair_counts(mesh, runs_a, runs_b, q_a: np.ndarray,
                 f = pallas_counts.sharded_signed_count_fn(
                     mesh, key[1], key[2], key[3], qb_bucket,
                     bool(kernel))
-            out = np.asarray(f(tuple(devs), qa_p, qb_p))
+            with device_section(("signed_pair", key, True)) as ds:
+                raw = f(tuple(devs), qa_p, qb_p)
+                ds.dispatched()
+                out = np.asarray(raw)
             _count_kernel_metrics(metrics, fallback=False)
         except Exception:
             # the XLA twin decides whether the KERNEL was the problem:
@@ -1026,12 +1042,18 @@ def tenant_pack_counts(mesh, pos_pack, cap_pos: int, neg_pack,
     key = ("tenant", mesh, t_bucket, cap_pos, cap_neg, qb)
 
     def _xla():
-        if mesh is None:
-            fn = tenant_count_local_fn(t_bucket, cap_pos, cap_neg, qb)
-        else:
-            fn = tenant_count_fn(mesh, t_bucket, cap_pos, cap_neg, qb)
-        out = fn(pos_pack, neg_pack, q_vs_neg, q_vs_pos)
-        return tuple(np.asarray(o).astype(np.int64) for o in out)
+        # host-tax dispatch boundary [ISSUE 14] — the fleet's ONE
+        # count call per coalesced micro-batch
+        with device_section(("tenant_count", key, False)) as ds:
+            if mesh is None:
+                fn = tenant_count_local_fn(t_bucket, cap_pos, cap_neg,
+                                           qb)
+            else:
+                fn = tenant_count_fn(mesh, t_bucket, cap_pos, cap_neg,
+                                     qb)
+            raw = fn(pos_pack, neg_pack, q_vs_neg, q_vs_pos)
+            ds.dispatched()
+            return tuple(np.asarray(o).astype(np.int64) for o in raw)
 
     if kernel is not None and key not in _KERNEL_BROKEN:
         try:
@@ -1047,7 +1069,10 @@ def tenant_pack_counts(mesh, pos_pack, cap_pos: int, neg_pack,
             else:
                 fn = pallas_counts.tenant_signed_count_fn(
                     mesh, t_bucket, cap_pos, cap_neg, qb, bool(kernel))
-            out = np.asarray(fn(pos_pack, neg_pack, qn_t, qp_t))
+            with device_section(("tenant_count", key, True)) as ds:
+                raw = fn(pos_pack, neg_pack, qn_t, qp_t)
+                ds.dispatched()
+                out = np.asarray(raw)
             _count_kernel_metrics(metrics, fallback=False)
             out = out.astype(np.int64)
             return (out[0].T, out[1].T, out[2].T, out[3].T)

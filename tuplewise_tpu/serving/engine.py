@@ -71,6 +71,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.ledger import WaveLedger
 from tuplewise_tpu.obs.report import INSERT_STAGES, stage_metric
 from tuplewise_tpu.obs.tracing import maybe_span
 from tuplewise_tpu.serving.index import ExactAucIndex
@@ -160,6 +161,11 @@ class ServingConfig:
     health: bool = True
     drift_window: int = 256        # micro-batches in the drift window
     drift_threshold: float = 0.05  # rolling |live - oracle| that alerts
+    # tail exemplars [ISSUE 14]: an insert whose measured latency
+    # lands at or above this threshold auto-captures its full host-tax
+    # ledger + trace id as a `tail_exemplar` flight event, so p99
+    # forensics read one dump. None = never capture.
+    tail_exemplar_ms: Optional[float] = None
     seed: int = 0
 
     def __post_init__(self):
@@ -196,6 +202,9 @@ class ServingConfig:
         if self.drift_threshold <= 0:
             raise ValueError(
                 f"drift_threshold must be > 0: {self.drift_threshold}")
+        if self.tail_exemplar_ms is not None and self.tail_exemplar_ms <= 0:
+            raise ValueError(
+                f"tail_exemplar_ms must be > 0: {self.tail_exemplar_ms}")
 
 
 class _Request:
@@ -300,6 +309,12 @@ class MicroBatchEngine:
         # values sum exactly to its measured insert latency
         self._h_stage = {s: m.histogram(stage_metric(s))
                          for s in INSERT_STAGES}
+        # host-tax wave ledger [ISSUE 14]: the below-stage-level
+        # decomposition (host Python vs dispatch vs device compute vs
+        # compile vs GC vs lock/queue wait) whose bucket sums tile the
+        # measured insert latency exactly
+        self.ledger = WaveLedger(m)
+        self._c_exemplars = m.counter("tail_exemplars_total")
         # live gauges [ISSUE 6 satellite]: the current reading, not the
         # cumulative history — what the MetricsFlusher streams out
         self._g_depth = m.gauge("queue_depth_live")
@@ -636,11 +651,24 @@ class MicroBatchEngine:
         # tile each request's [enqueue, resolve] lifetime, so stage
         # values sum EXACTLY to the measured insert latency
         t_start = time.perf_counter()            # queue_wait ends
+        # host-tax wave [ISSUE 14]: device sections and GC pauses on
+        # this thread now bill to this wave; closed at resolve
+        wave = self.ledger.begin_wave()
+        try:
+            self._apply_inserts_wave(run, t_start, wave)
+        finally:
+            # the failure path (exception fails the run upstream)
+            # must not leave the wave bound to the batcher thread
+            self.ledger.abort_wave(wave)
+
+    def _apply_inserts_wave(self, run: List[_Request], t_start: float,
+                            wave) -> None:
         scores = np.concatenate([r.scores for r in run])
         labels = np.concatenate([r.labels for r in run]).astype(bool)
         with maybe_span(self.tracer, "insert.apply",
                         parent=run[0].span, n_requests=len(run),
                         n_events=len(scores)):
+            t_lock_req = time.perf_counter()     # lock wait begins
             with self._lock:
                 t_lock = time.perf_counter()     # coalesce = concat+lock
                 if self._recovery is not None:
@@ -675,9 +703,33 @@ class MicroBatchEngine:
         h["snapshot"].observe_n(t_snap - t_stream, n)
         h["resolve"].observe_n(t_end - t_snap, n)
         qw = h["queue_wait"]
+        queue_waits = []
         for r in run:
-            qw.observe(t_start - r.t_enqueue)
+            qw_r = t_start - r.t_enqueue
+            queue_waits.append(qw_r)
+            qw.observe(qw_r)
             self._h_insert_lat.observe(t_end - r.t_enqueue)
+        # close the host-tax wave [ISSUE 14]: bucket sums tile each
+        # request's [enqueue, resolve] lifetime exactly (host_python
+        # is the remainder after lock wait / device sections / GC)
+        buckets = self.ledger.finish_wave(
+            wave, t_start=t_start, t_end=t_end,
+            queue_waits=queue_waits,
+            t_lock_req=t_lock_req, t_lock=t_lock)
+        th = self.config.tail_exemplar_ms
+        if th is not None:
+            for r, qw_r in zip(run, queue_waits):
+                lat_ms = (t_end - r.t_enqueue) * 1e3
+                if lat_ms >= th:
+                    # tail exemplar [ISSUE 14]: the full ledger of the
+                    # slow request + its trace id, in the flight ring
+                    self._c_exemplars.inc()
+                    self.flight.record(
+                        "tail_exemplar", kind_req="insert",
+                        trace_id=(r.span.trace_id
+                                  if r.span is not None else None),
+                        lat_ms=lat_ms, n_events=len(r.scores),
+                        buckets=dict(buckets, queue_wait=qw_r))
         # drift check [ISSUE 7]: live budgeted estimate vs the exact
         # oracle prefix, once per micro-batch, AFTER the latency
         # boundaries — bookkeeping, not request service

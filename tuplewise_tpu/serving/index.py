@@ -122,6 +122,7 @@ from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
+from tuplewise_tpu.obs.ledger import device_section
 from tuplewise_tpu.obs.tracing import maybe_span
 
 _MIN_BUCKET = 256
@@ -516,9 +517,15 @@ class ExactAucIndex:
             base_p[: len(side.base)] = side.base
             q_p = np.zeros(qb, dtype=self.dtype)
             q_p[: len(q)] = q
-            less, leq = _jit_count_fn(bb, qb)(base_p, q_p)
-            return (np.asarray(less)[: len(q)].astype(np.int64),
-                    np.asarray(leq)[: len(q)].astype(np.int64))
+            # host-tax dispatch boundary [ISSUE 14]: the key mirrors
+            # the lru cache key of the jit factory, so a first-seen
+            # key IS a compile-ladder growth event
+            with device_section(("count", bb, qb)) as ds:
+                less, leq = _jit_count_fn(bb, qb)(base_p, q_p)
+                ds.dispatched()
+                less = np.asarray(less)[: len(q)].astype(np.int64)
+                leq = np.asarray(leq)[: len(q)].astype(np.int64)
+            return less, leq
         less = np.searchsorted(side.base, q, side="left")
         leq = np.searchsorted(side.base, q, side="right")
         return less.astype(np.int64), leq.astype(np.int64)
@@ -1035,7 +1042,12 @@ class ExactAucIndex:
                 b = _next_bucket(n)
                 padded = np.full(b, np.inf, dtype=self.dtype)
                 padded[:n] = merged
-                merged = np.asarray(_jit_sort_fn(b)(padded))[:n]
+                # on-thread sort runs inside the insert wave: bill the
+                # compaction-pause device time honestly [ISSUE 14]
+                with device_section(("sort", b)) as ds:
+                    out = _jit_sort_fn(b)(padded)
+                    ds.dispatched()
+                    merged = np.asarray(out)[:n]
         elif len(buf_sorted) == 0:
             merged = side_base
         else:

@@ -75,6 +75,7 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
            flight_out: Optional[str] = None,
            slo_spec=None, controller_spec=None,
            run_id: Optional[str] = None,
+           prof=None, prof_out: Optional[str] = None,
            **overrides) -> dict:
     """Drive the engine with one request per event (or per ``chunk``
     events) and return the measurement record.
@@ -114,6 +115,14 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     ``profile_dir`` brackets the timed window in a ``jax.profiler``
     trace; ``flight_out`` dumps the engine's flight recorder after the
     run. The warmup pass stays untraced (it measures nothing).
+
+    ``prof`` / ``prof_out`` [ISSUE 14]: the host-tax sampling
+    profiler. ``prof`` is an ``obs.prof.SamplingProfiler`` instance
+    (caller keeps it for extra exports) or truthy to create one; it
+    brackets exactly the timed window (warmup stays unprofiled).
+    ``prof_out`` writes folded stacks (``*.collapsed``/``*.txt``) or
+    a speedscope JSON (anything else); the record carries
+    ``prof_out`` / ``prof_samples`` / ``prof_overhead_fraction``.
 
     ``slo_spec`` [ISSUE 7]: anything ``obs.slo.SloSpec.from_spec``
     accepts. An ``SloMonitor`` rides the metrics flusher (an
@@ -185,6 +194,13 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
                            if slo_monitor is not None else ())).start()
         from tuplewise_tpu.utils.profiling import trace as _jax_trace
 
+        profiler = None
+        if prof is not None and prof is not False or prof_out:
+            from tuplewise_tpu.obs.prof import SamplingProfiler
+
+            profiler = (prof if isinstance(prof, SamplingProfiler)
+                        else SamplingProfiler(metrics=eng.metrics))
+            profiler.start()
         with _jax_trace(profile_dir):
             t0 = time.perf_counter()
             for i in range(0, n, chunk):
@@ -226,6 +242,10 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
                 except BackpressureError:
                     dropped += 1
             wall = time.perf_counter() - t0
+        if profiler is not None:
+            # stop INSIDE the engine scope: the profiled window is the
+            # timed window, not the drain/close tail
+            profiler.stop()
         if eng.index is not None and cfg.bg_compact:
             # settle in-flight background builds OUTSIDE the timed
             # window so compaction/pause fields are deterministic
@@ -319,6 +339,18 @@ def replay(scores, labels, config: Optional[ServingConfig] = None,
     # this record and `tuplewise serve`'s exit summary, so the
     # recovery/chaos counters can never drift between them again
     rec["report"] = service_report(stats["metrics"], slo=slo_monitor)
+    # host-tax ledger [ISSUE 14]: the headline split at top level (the
+    # full block also rides rec["report"]["host_tax"])
+    rec["host_tax"] = rec["report"]["host_tax"]
+    if profiler is not None:
+        from tuplewise_tpu.obs.prof import export_profile
+
+        written = export_profile(profiler, prof_out)
+        if written:
+            rec["prof_out"] = written
+        rec["prof_samples"] = profiler.samples
+        rec["prof_overhead_fraction"] = profiler.overhead_fraction()
+        rec["prof_throttles"] = profiler.throttles
     if slo_monitor is not None:
         rec["slo"] = slo_monitor.report()
     if controller is not None:
@@ -600,6 +632,7 @@ def replay_fleet(scores, labels, tenants,
     if run_id is not None:
         rec["run_id"] = run_id
     rec["report"] = service_report(m, chaos=injector, slo=slo_monitor)
+    rec["host_tax"] = rec["report"]["host_tax"]   # [ISSUE 14]
     if slo_monitor is not None:
         rec["slo"] = slo_monitor.report()
     if controller is not None:

@@ -96,6 +96,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from tuplewise_tpu.obs.flight import FlightRecorder
+from tuplewise_tpu.obs.ledger import WaveLedger
 from tuplewise_tpu.obs.tracing import maybe_span
 from tuplewise_tpu.serving.engine import (
     BackpressureError, DeadlineExceededError, EngineClosedError,
@@ -1491,6 +1492,14 @@ class MultiTenantEngine:
             "batch_fill", buckets=[i / 16 for i in range(1, 17)])
         self._g_depth = m.gauge("queue_depth_live")
         self._g_live = m.gauge("tenants_live")
+        # host-tax wave ledger [ISSUE 14]: the fleet's insert waves
+        # get the same below-stage decomposition as the single-tenant
+        # engine (the per-tenant dict hops + pack splice ARE the
+        # host_python bucket the one-dispatch refactor targets); the
+        # fleet path takes its lock inside apply_inserts, so lock wait
+        # stays inside host_python here
+        self.ledger = WaveLedger(m)
+        self._c_exemplars = m.counter("tail_exemplars_total")
         self._pending: Dict[str, Deque[_FleetRequest]] = {}
         self._rotation: List[str] = []
         self._n_pending = 0
@@ -1996,6 +2005,17 @@ class MultiTenantEngine:
     def _apply_insert_wave(self, groups) -> None:
         """One wave of per-tenant insert runs → ONE fleet count +
         per-tenant stream extends; futures resolve per request."""
+        t_start = time.perf_counter()
+        # host-tax wave [ISSUE 14]: opened before the per-tenant
+        # concat/dict work so plan assembly bills to host_python
+        wave = self.ledger.begin_wave()
+        try:
+            self._apply_insert_wave_ledgered(groups, t_start, wave)
+        finally:
+            self.ledger.abort_wave(wave)
+
+    def _apply_insert_wave_ledgered(self, groups, t_start: float,
+                                    wave) -> None:
         items = []
         for tid, reqs in groups:
             scores = np.concatenate([r.scores for r in reqs])
@@ -2023,6 +2043,14 @@ class MultiTenantEngine:
                         self._finish(r)
                 return
         now = time.perf_counter()
+        # close the host-tax wave [ISSUE 14] at the resolve boundary:
+        # per-request buckets tile [enqueue, resolve] exactly
+        n_reqs = sum(len(reqs) for _, reqs in groups)
+        buckets = self.ledger.finish_wave(
+            wave, t_start=t_start, t_end=now,
+            queue_waits=[t_start - r.t_enqueue
+                         for _, reqs in groups for r in reqs])
+        th = self.config.tail_exemplar_ms
         for tid, reqs in groups:
             h_tenant = None
             if self.tenancy.tenant_metrics:
@@ -2043,6 +2071,17 @@ class MultiTenantEngine:
                 self._h_insert_lat.observe(lat)
                 if h_tenant is not None:
                     h_tenant.observe(lat)
+                if th is not None and lat * 1e3 >= th:
+                    # tenant-attributed tail exemplar [ISSUE 14]
+                    self._c_exemplars.inc()
+                    self.flight.record(
+                        "tail_exemplar", kind_req="insert", tenant=tid,
+                        trace_id=(r.span.trace_id
+                                  if r.span is not None else None),
+                        lat_ms=lat * 1e3, n_events=len(r.scores),
+                        n_requests=n_reqs,
+                        buckets=dict(buckets,
+                                     queue_wait=t_start - r.t_enqueue))
                 self._finish(r, now)
 
     def _apply_score_wave(self, groups) -> None:
