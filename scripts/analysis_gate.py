@@ -1,22 +1,36 @@
 """CI leg for the static invariant checkers [ISSUE 12, dataflow tier
-ISSUE 13]: run ``tuplewise check`` in-process, write the JSON report
-artifact (and optionally SARIF for inline PR annotations), diff the
-overflow certificate against the committed baseline, and fail on any
+ISSUE 13, host-cost/lifecycle tier ISSUE 15]: run ``tuplewise check``
+in-process, write the JSON report artifact (and optionally SARIF for
+inline PR annotations), diff the overflow certificate AND the hotpath
+cost certificate against their committed baselines, and fail on any
 unwaived finding, waiver-file error, parse error, import cycle, or
 certificate drift.
 
 The finding ratchet lives in the waiver semantics (each waiver
-absorbs a bounded count — analysis/waivers.py). The overflow
-certificate HAS a baseline by design
-(``tuplewise_tpu/analysis/exactness_bounds.toml``): the bound table
-is a function of the compile-ladder maxima, so a ladder bump that
-breaks int32 safety must fail with the violating bound NAMED — that
-requires committing the expected bounds, not just "no new findings".
+absorbs a bounded count — analysis/waivers.py). Both certificates
+HAVE baselines by design:
+
+* ``tuplewise_tpu/analysis/exactness_bounds.toml`` — the int32 bound
+  table is a function of the compile-ladder maxima, so a ladder bump
+  that breaks int32 safety must fail with the violating bound NAMED.
+* ``tuplewise_tpu/analysis/hotpath_budget.toml`` [ISSUE 15] — the
+  per-request-path-root host-cost counters. A counter that GROWS (a
+  per-event allocation/lock/dispatch added to the hot path) fails
+  naming the root, the contributing sites, and the violated budget
+  line. A counter that SHRINKS is the downward ratchet the
+  one-dispatch refactor drives: the gate rewrites the budget file in
+  place so the improvement is committed with the PR.
+
+The gate also asserts the parse cache actually caches [ISSUE 15
+satellite]: a second in-job corpus load must hit (> 0 hits) or the
+gate fails — a cache that silently never hits is a perf regression
+for every CI run after it.
 
 Usage: python scripts/analysis_gate.py
            [--out results/analysis_report.json]
            [--sarif results/analysis_report.sarif]
-           [--no-cache]
+           [--hotpath-out results/hotpath_certificate.json]
+           [--update-hotpath-budget] [--no-cache]
 """
 
 from __future__ import annotations
@@ -31,6 +45,8 @@ sys.path.insert(0, REPO)
 
 BASELINE = os.path.join(
     REPO, "tuplewise_tpu", "analysis", "exactness_bounds.toml")
+HOTPATH_BUDGET = os.path.join(
+    REPO, "tuplewise_tpu", "analysis", "hotpath_budget.toml")
 
 _SARIF_RULE_HELP = {
     "race-unguarded-shared":
@@ -48,6 +64,29 @@ _SARIF_RULE_HELP = {
         "int32 accumulator bound exceeds 2^31-1 at ladder maxima",
     "overflow-unproved":
         "int32 accumulator the overflow classifier cannot bound",
+    "hotpath-root-missing":
+        "declared request-path root no longer defined in the corpus",
+    "future-leak":
+        "request futures can be stranded unresolved on an exception "
+        "path",
+    "future-double-resolve":
+        "future resolution without done() guard or try arbitration "
+        "in a multi-resolver class",
+    "future-close-leak":
+        "close() never reaches a drain that fails queued futures",
+    "thread-undisciplined":
+        "Thread/Timer neither daemonized nor joined/cancelled from a "
+        "lifecycle method",
+    "handle-leak":
+        "file handle opened outside `with` with no owning close on "
+        "the exception path",
+    "error-unhandled-protocol":
+        "typed serving error with no {\"error\": ...} wire handler",
+    "error-not-doctor-visible":
+        "typed serving error invisible to obs/report.py and "
+        "obs/doctor.py",
+    "error-undocumented":
+        "typed serving error README/DESIGN never mention",
 }
 
 
@@ -120,14 +159,27 @@ def main(argv=None) -> int:
                     help="also write a SARIF 2.1.0 report here "
                          "(uploaded next to the JSON so findings "
                          "render as inline PR annotations)")
+    ap.add_argument("--hotpath-out", type=str,
+                    default=os.path.join(REPO, "results",
+                                         "hotpath_certificate.json"),
+                    help="write the hotpath cost certificate artifact "
+                         "here [ISSUE 15]")
+    ap.add_argument("--update-hotpath-budget", action="store_true",
+                    help="rewrite the committed hotpath budget from "
+                         "the freshly derived certificate (first "
+                         "generation / reviewed re-baseline)")
+    ap.add_argument("--jobs", type=int, default=None,
+                    help="worker processes for the passes (default "
+                         "auto)")
     ap.add_argument("--no-cache", action="store_true",
                     help="disable the content-sha parse cache")
     args = ap.parse_args(argv)
 
-    from tuplewise_tpu.analysis import exactness
+    from tuplewise_tpu.analysis import exactness, hotpath
     from tuplewise_tpu.analysis.runner import run_checks
 
-    report = run_checks(root=REPO, use_cache=not args.no_cache)
+    report = run_checks(root=REPO, use_cache=not args.no_cache,
+                        jobs=args.jobs)
 
     # overflow-certificate baseline diff [ISSUE 13 satellite]: the
     # derived bound table must match the committed envelope exactly
@@ -142,6 +194,62 @@ def main(argv=None) -> int:
     if cert_errors:
         report["ok"] = False
 
+    # hotpath-budget diff [ISSUE 15]: growth fails naming root + site
+    # + budget line; shrinkage ratchets the committed file downward.
+    # The second-in-job cache probe runs FIRST — the budget rewrite
+    # below changes the cache epoch, which must not void the probe.
+    cache_second_hits = None
+    if not args.no_cache:
+        from tuplewise_tpu.analysis.cache import (
+            ParseCache, compute_epoch,
+        )
+        from tuplewise_tpu.analysis.core import ModuleSet
+
+        probe = ParseCache(REPO, epoch=compute_epoch(REPO))
+        ModuleSet.from_repo(REPO, cache=probe)
+        cache_second_hits = probe.stats()["hits"]
+        if cache_second_hits <= 0:
+            report["ok"] = False
+            report.setdefault("gate_errors", []).append(
+                "parse cache never hits: the second in-job corpus "
+                "load re-parsed everything — the epoch/key logic "
+                "broke (ISSUE 15 satellite contract)")
+
+    hot_cert = report.get("hotpath_certificate")
+    hot_errors, hot_shrinks = [], []
+    if hot_cert is None:
+        hot_errors = ["runner produced no hotpath certificate"]
+    elif args.update_hotpath_budget:
+        with open(HOTPATH_BUDGET, "w", encoding="utf-8") as f:
+            f.write(hotpath.format_budget(hot_cert))
+        print(f"hotpath budget rewritten: {HOTPATH_BUDGET}",
+              file=sys.stderr)
+    elif os.path.exists(HOTPATH_BUDGET):
+        with open(HOTPATH_BUDGET, "r", encoding="utf-8") as f:
+            hot_errors, hot_shrinks = hotpath.compare_to_budget(
+                hot_cert, f.read())
+        if not hot_errors and hot_shrinks:
+            # the downward ratchet: commit the improvement
+            with open(HOTPATH_BUDGET, "w", encoding="utf-8") as f:
+                f.write(hotpath.format_budget(hot_cert))
+    else:
+        hot_errors = [f"missing committed budget {HOTPATH_BUDGET} — "
+                      "generate it with --update-hotpath-budget and "
+                      "commit after review"]
+    report["hotpath_budget_diff"] = hot_errors
+    report["hotpath_budget_ratchet"] = hot_shrinks
+    if hot_errors:
+        report["ok"] = False
+
+    if args.hotpath_out and hot_cert is not None:
+        os.makedirs(os.path.dirname(args.hotpath_out) or ".",
+                    exist_ok=True)
+        with open(args.hotpath_out, "w", encoding="utf-8") as f:
+            json.dump({"stage": "hotpath_certificate",
+                       "certificate": hot_cert,
+                       "budget_diff": hot_errors,
+                       "ratchet": hot_shrinks}, f, indent=2)
+
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=2)
@@ -152,14 +260,19 @@ def main(argv=None) -> int:
 
     s = report["summary"]
     c = s["cache"]
+    t = s["timings"]
     print(f"ANALYSIS GATE: {s['files_analyzed']} files, "
           f"{s['findings_total']} findings "
           f"({s['waived']} waived, {s['unwaived']} unwaived), "
           f"{len(report['import_cycles'])} import cycles, "
           f"{len(report['dead_symbols'])} dead public symbols "
           f"(warn-only), cache {c['hits']}/{c['hits'] + c['misses']} "
-          f"hits, certificate "
-          f"{'OK' if not cert_errors else 'DRIFT'}", file=sys.stderr)
+          f"hits (2nd run {cache_second_hits}), "
+          f"{t['total_s']:.2f}s jobs={t['jobs']}, certificate "
+          f"{'OK' if not cert_errors else 'DRIFT'}, hotpath budget "
+          f"{'OK' if not hot_errors else 'DRIFT'}"
+          + (f" (ratcheted {len(hot_shrinks)} counters down)"
+             if hot_shrinks else ""), file=sys.stderr)
     for f_ in report["findings"]:
         print(f"  UNWAIVED {f_['rule']}: {f_['file']}:{f_['line']} "
               f"[{f_['symbol']}] {f_['message']}", file=sys.stderr)
@@ -172,6 +285,13 @@ def main(argv=None) -> int:
               file=sys.stderr)
     for e in cert_errors:
         print(f"  CERTIFICATE: {e}", file=sys.stderr)
+    for e in hot_errors:
+        print(f"  HOTPATH BUDGET: {e}", file=sys.stderr)
+    for e in hot_shrinks:
+        print(f"  hotpath ratchet (budget rewritten): {e}",
+              file=sys.stderr)
+    for e in report.get("gate_errors", ()):
+        print(f"  GATE: {e}", file=sys.stderr)
     # one machine-readable verdict line on stdout (the doctor/perf-gate
     # convention: tail -n 1 | json)
     print(json.dumps({"stage": "analysis_gate", "ok": report["ok"],
@@ -179,7 +299,10 @@ def main(argv=None) -> int:
                       "waived": s["waived"],
                       "unused_waivers": s["waivers_unused"],
                       "certificate_ok": not cert_errors,
-                      "cache_hits": c["hits"]}))
+                      "hotpath_budget_ok": not hot_errors,
+                      "hotpath_ratcheted": len(hot_shrinks),
+                      "cache_hits": c["hits"],
+                      "cache_second_run_hits": cache_second_hits}))
     if not report["ok"]:
         print("ANALYSIS GATE FAIL (report in "
               f"{args.out})", file=sys.stderr)

@@ -8,17 +8,23 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-# Static invariant checks [ISSUE 12, dataflow tier ISSUE 13] —
-# FIRST, because they need no jax and fail in seconds: lock-order/
-# thread discipline, traced-code purity, telemetry cross-reference,
-# compile-ladder discipline (flow-sensitive), config/CLI/doc drift,
-# guard-inference race detection, integer-exactness + int32 overflow
-# certification (diffed against the committed
-# analysis/exactness_bounds.toml envelope), import cycles. Findings
-# are suppressible only via the committed
-# tuplewise_tpu/analysis/waivers.toml (bounded per-waiver counts =
-# the ratchet); the JSON report lands at results/analysis_report.json
-# and the SARIF twin (inline PR annotations) next to it.
+# Static invariant checks [ISSUE 12, dataflow tier ISSUE 13,
+# host-cost/lifecycle tier ISSUE 15] — FIRST, because they need no
+# jax and fail in seconds: lock-order/thread discipline, traced-code
+# purity, telemetry cross-reference, compile-ladder discipline
+# (flow-sensitive), config/CLI/doc drift, guard-inference race
+# detection, integer-exactness + int32 overflow certification
+# (diffed against the committed analysis/exactness_bounds.toml
+# envelope), host-cost certification of the request path (per-root
+# cost counters diffed against analysis/hotpath_budget.toml — growth
+# fails naming root/site/budget line, shrinkage ratchets the budget
+# down), exception-flow/future-lifecycle + error-taxonomy analysis,
+# import cycles. The gate also asserts the epoch-keyed parse cache
+# hits on a second in-job corpus load. Findings are suppressible only
+# via the committed tuplewise_tpu/analysis/waivers.toml (bounded
+# per-waiver counts = the ratchet); the JSON report lands at
+# results/analysis_report.json, the SARIF twin (inline PR
+# annotations) and the hotpath certificate artifact next to it.
 timeout -k 10 180 python scripts/analysis_gate.py \
     --sarif results/analysis_report.sarif
 rc=$?

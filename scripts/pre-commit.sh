@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Fast pre-commit loop for the static invariant checkers [ISSUE 15]:
+# `tuplewise check --diff HEAD` restricts findings to the files you
+# changed plus everything that (transitively) imports them — the
+# reverse-dependency closure from the module graph — so the loop runs
+# in a couple of seconds instead of re-judging the whole repo.
+#
+# Install as a git hook:
+#   ln -sf ../../scripts/pre-commit.sh .git/hooks/pre-commit
+#
+# The full unscoped run (waiver staleness, certificate diffs, SARIF)
+# still happens in CI: scripts/analysis_gate.py is the first ci.sh
+# leg. This hook is the tight loop, not the gate.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec python -m tuplewise_tpu.harness.cli check --diff HEAD "$@"

@@ -154,7 +154,9 @@ def main(out: str | None = None) -> int:
     )
     for path in paths:
         name = os.path.basename(path)
-        for line in open(path):
+        with open(path) as fh:
+            lines = fh.readlines()
+        for line in lines:
             r = json.loads(line)
             cfg, M = r.get("config"), r.get("n_reps")
             # only harness rows qualify: a dict config with the

@@ -533,7 +533,7 @@ def test_repo_every_pass_ran(repo_report):
     assert set(per_pass) == {"lock-order", "traced-purity",
                              "telemetry-xref", "compile-ladder",
                              "config-drift", "races", "exactness",
-                             "module-graph"}
+                             "hotpath", "lifecycle", "module-graph"}
     # the waived findings prove the passes bite on the real tree
     assert repo_report["summary"]["waived"] > 0
 

@@ -41,6 +41,27 @@ chase):
                         compile-ladder maxima, diffed in CI against
                         the committed exactness_bounds.toml).
 
+The host-cost + lifecycle tier [ISSUE 15] ratchets the one-dispatch
+serving-core refactor:
+
+* ``hotpath``         — abstract cost certification of everything
+                        reachable from the request-path roots:
+                        allocations / ctors / np allocations /
+                        attribute hops / locks / device dispatches,
+                        classified O(1)/O(tenants)/O(events); the
+                        certificate is diffed in CI against the
+                        committed hotpath_budget.toml — growth fails
+                        naming root + site + budget line, shrinkage
+                        ratchets the budget down.
+* ``lifecycle``       — exception-flow + resource lifecycle: every
+                        Future resolves on every path (leak /
+                        double-resolve / close-drain rules, with the
+                        pre-PR-8 and pre-PR-11 holes as regression
+                        fixtures), Thread/Timer daemon-or-join, file
+                        handles close on exception paths, and every
+                        typed serving error is wire-handled,
+                        doctor-visible, and documented.
+
 Findings are suppressible ONLY via the committed, per-finding-justified
 waiver file (``analysis/waivers.toml``); each waiver absorbs a bounded
 count of findings, so NEW violations fail even where old waived ones

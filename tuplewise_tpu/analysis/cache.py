@@ -13,6 +13,15 @@ Keys include an ``ANALYSIS_CACHE_VERSION`` stamp and the Python
 version: bumping the version whenever ``core.ModuleInfo``'s shape
 changes invalidates every stale entry at once — a wrong hit can never
 outlive the code that wrote it.
+
+Keys ALSO include a **global cache epoch** [ISSUE 15 bugfix]: the
+content digest of the checker package itself plus the committed
+waiver/budget/bounds files (``compute_epoch``). Content-sha-of-the-
+analyzed-file alone is not a sound key for anything derived from the
+ANALYZER: a waivers.toml edit, a checker bugfix, or a budget change
+must force a cold re-run, never replay results computed under the old
+rules. The epoch folds all of that state into every key, so editing
+any of it invalidates the whole cache at once.
 """
 
 from __future__ import annotations
@@ -34,14 +43,41 @@ def _stamp() -> str:
             f"{sys.version_info[1]}")
 
 
+def compute_epoch(root: str) -> str:
+    """Global cache epoch [ISSUE 15 bugfix]: digest of the checker
+    package sources AND the committed waivers/budget/bounds TOMLs
+    under ``tuplewise_tpu/analysis/``. Any edit to the analyzer or
+    its committed inputs changes the epoch, so every cached entry
+    goes cold at once — stale results can never replay across a
+    checker-version bump or a waiver/budget change."""
+    h = hashlib.sha256()
+    h.update(_stamp().encode())
+    adir = os.path.join(root, "tuplewise_tpu", "analysis")
+    if os.path.isdir(adir):
+        for fn in sorted(os.listdir(adir)):
+            if not fn.endswith((".py", ".toml")):
+                continue
+            h.update(fn.encode())
+            try:
+                with open(os.path.join(adir, fn), "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(b"<unreadable>")
+    return h.hexdigest()[:16]
+
+
 class ParseCache:
     """Content-sha keyed store of pickled ModuleInfo objects. One file
     per module path (sha inside), so stale entries replace themselves
-    and the directory never grows past the corpus size."""
+    and the directory never grows past the corpus size. ``epoch``
+    (see :func:`compute_epoch`) folds the analyzer's own state into
+    every key."""
 
     def __init__(self, root: str,
-                 subdir: str = DEFAULT_CACHE_DIR):
+                 subdir: str = DEFAULT_CACHE_DIR,
+                 epoch: str = ""):
         self.dir = os.path.join(root, subdir)
+        self.epoch = epoch if epoch else compute_epoch(root)
         self.hits = 0
         self.misses = 0
         self._ready = False
@@ -55,10 +91,10 @@ class ParseCache:
                 return False
         return True
 
-    @staticmethod
-    def key(path: str, source: str) -> str:
+    def key(self, path: str, source: str) -> str:
         h = hashlib.sha256()
         h.update(_stamp().encode())
+        h.update(self.epoch.encode())
         h.update(path.encode())
         h.update(source.encode())
         return h.hexdigest()
@@ -99,4 +135,4 @@ class ParseCache:
 
     def stats(self) -> dict:
         return {"enabled": True, "hits": self.hits,
-                "misses": self.misses}
+                "misses": self.misses, "epoch": self.epoch}

@@ -40,9 +40,43 @@ def import_graph(ms: ModuleSet) -> Dict[str, Set[str]]:
             elif isinstance(node, ast.ImportFrom) and node.module \
                     and node.module.startswith(_PKG):
                 edges.add(node.module)
+                # `from pkg import submodule` edges resolve to the
+                # submodule itself when one exists — the diff
+                # closure's blast radius needs the finer edge
+                for a in node.names:
+                    cand = f"{node.module}.{a.name}"
+                    if ms.path_of_module(cand) is not None:
+                        edges.add(cand)
         graph[mod] = {e for e in edges
                       if ms.path_of_module(e) is not None}
     return graph
+
+
+def reverse_closure(ms: ModuleSet, paths: Set[str]) -> Set[str]:
+    """The changed files plus every corpus file that (transitively)
+    imports one of them — the blast radius a pre-commit ``check
+    --diff`` must re-judge [ISSUE 15 satellite]. Non-package files
+    (scripts, bench.py) participate as themselves: nothing imports
+    them, but their own findings stay in scope."""
+    graph = import_graph(ms)
+    rev: Dict[str, Set[str]] = {}
+    for mod, edges in graph.items():
+        for e in edges:
+            rev.setdefault(e, set()).add(mod)
+    out = {p for p in paths}
+    frontier = [ms.module_name(p) for p in paths if p in ms.modules]
+    seen = set(frontier)
+    while frontier:
+        mod = frontier.pop()
+        for importer in rev.get(mod, ()):
+            if importer in seen:
+                continue
+            seen.add(importer)
+            frontier.append(importer)
+            p = ms.path_of_module(importer)
+            if p is not None:
+                out.add(p)
+    return out
 
 
 def find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
@@ -83,15 +117,21 @@ def dead_symbols(ms: ModuleSet) -> List[dict]:
                               encoding="utf-8") as f:
                         sources[f"tests/{fn}"] = f.read()
     names = public_symbols(ms)
+    uniq = sorted({n for _, n, _ in names})
+    if not uniq:
+        return []
+    # ONE combined word-boundary scan per source instead of one regex
+    # per symbol per source — the per-symbol loop was the slowest
+    # single step of the whole check (measured ~15s of a ~25s gate)
+    # [ISSUE 15 satellite: the timing block made it visible]
+    pat = re.compile(r"\b(" + "|".join(re.escape(n) for n in uniq)
+                     + r")\b")
+    appears: Dict[str, Set[str]] = {}
+    for p, src in sources.items():
+        for hit in set(pat.findall(src)):
+            appears.setdefault(hit, set()).add(p)
     for path, name, line in names:
-        pat = re.compile(rf"\b{re.escape(name)}\b")
-        used = set()
-        for p, src in sources.items():
-            if p == path:
-                continue
-            if pat.search(src):
-                used.add(p)
-        refs[f"{path}:{name}"] = used
+        refs[f"{path}:{name}"] = appears.get(name, set()) - {path}
     return [{"file": path, "symbol": name, "line": line}
             for path, name, line in names
             if not refs[f"{path}:{name}"]]

@@ -683,6 +683,15 @@ def main(argv=None) -> int:
                    help="disable the content-sha parse cache "
                         "(.tuplewise_check_cache/) and reparse "
                         "every module [ISSUE 13]")
+    p.add_argument("--diff", type=str, default=None, metavar="REF",
+                   help="restrict findings to files changed vs this "
+                        "git ref plus their reverse-dependency "
+                        "closure — the fast pre-commit loop "
+                        "(scripts/pre-commit.sh) [ISSUE 15]")
+    p.add_argument("--jobs", type=int, default=None,
+                   help="run the independent passes in N worker "
+                        "processes (default: auto — cpu count, "
+                        "serial on <= 2 cores) [ISSUE 15]")
 
     p = sub.add_parser(
         "replay",

@@ -406,10 +406,15 @@ class MicroBatchEngine:
                     raise BackpressureError(
                         f"queue full ({self.config.queue_size}); request "
                         "rejected") from None
-                # drop_oldest: shed the stalest queued request
+                # drop_oldest: shed the stalest queued request. The
+                # done() guard arbitrates against the deadline reaper
+                # [ISSUE 15]: the reaper fails queued requests WITHOUT
+                # dequeuing them, so the one we just popped may
+                # already hold its typed expiry — set_exception again
+                # would raise InvalidStateError on the submit path.
                 try:
                     old = self._q.get_nowait()
-                    if old is not None:
+                    if old is not None and not old.future.done():
                         self._c_dropped.inc()
                         old.future.set_exception(BackpressureError(
                             "dropped by a newer request (drop_oldest)"))

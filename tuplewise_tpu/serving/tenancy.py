@@ -1984,15 +1984,34 @@ class MultiTenantEngine:
         self._c_batches.inc()
         self._h_fill.observe(len(batch) / self.config.max_batch)
         for wave in self._waves(batch):
-            if wave["insert"]:
-                self._apply_insert_wave(wave["insert"])
-            if wave["score"]:
-                self._apply_score_wave(wave["score"])
-            for tid, reqs in wave["query"]:
-                snap = self.tenant_stats(tid)
-                for r in reqs:
-                    r.future.set_result(snap)
-                    self._finish(r)
+            # umbrella exception path [ISSUE 15]: the apply helpers
+            # fail their own dispatch errors, but an exception in the
+            # post-apply resolve/metrics code — or in tenant_stats on
+            # the query path, which had NO handler at all — must still
+            # fail every unresolved future in the wave. Stranded
+            # futures hang their callers until timeout and the
+            # supervisor restart hides the cause; the lifecycle pass's
+            # future-leak rule pins this umbrella. The done() guards
+            # keep resolution single-shot against the reaper.
+            try:
+                if wave["insert"]:
+                    self._apply_insert_wave(wave["insert"])
+                if wave["score"]:
+                    self._apply_score_wave(wave["score"])
+                for tid, reqs in wave["query"]:
+                    snap = self.tenant_stats(tid)
+                    for r in reqs:
+                        if not r.future.done():
+                            r.future.set_result(snap)
+                        self._finish(r)
+            except Exception as e:      # fail the wave, keep serving
+                for group in (wave["insert"], wave["score"],
+                              wave["query"]):
+                    for _tid, reqs in group:
+                        for r in reqs:
+                            if not r.future.done():
+                                r.future.set_exception(e)
+                                self._finish(r)
 
     def _finish(self, r: _FleetRequest,
                 now: Optional[float] = None) -> None:
